@@ -1,0 +1,11 @@
+"""oryx_tpu — a TPU-native lambda-architecture realtime ML framework.
+
+A from-scratch JAX/XLA re-design with the capability surface of Oryx 2
+(batch/speed/serving tiers over topics and a data store; ALS, k-means and
+random-decision-forest verticals; HOCON-style config; PMML model artifacts;
+REST serving API), built TPU-first: models are sharded device arrays on a
+jax mesh, batch jobs are pjit'd programs, and incremental updates are jit'd
+microbatch kernels.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,52 @@
+"""Host-side parallel execution helpers.
+
+Equivalent of the reference's ExecUtils (framework/oryx-common/.../lang/
+ExecUtils.java:42-118): fixed-pool parallel map/collect used for hyperparameter
+candidate builds and load tests. On TPU the heavy work inside each task is a
+pjit'd program; this pool only overlaps host-side orchestration.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import logging
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+log = logging.getLogger(__name__)
+
+
+def do_in_parallel(num_tasks: int, fn: Callable[[int], None], parallelism: int | None = None) -> None:
+    """Run fn(0..num_tasks-1), up to ``parallelism`` at a time."""
+    for _ in collect_in_parallel(num_tasks, fn, parallelism):
+        pass
+
+
+def collect_in_parallel(
+    num_tasks: int, fn: Callable[[int], T], parallelism: int | None = None
+) -> list[T]:
+    """Run fn over task indices in a bounded pool and collect results in order."""
+    if num_tasks <= 0:
+        return []
+    parallelism = max(1, min(parallelism or num_tasks, num_tasks))
+    if parallelism == 1:
+        return [fn(i) for i in range(num_tasks)]
+    with cf.ThreadPoolExecutor(max_workers=parallelism) as pool:
+        futures = [pool.submit(_logging_call, fn, i) for i in range(num_tasks)]
+        return [f.result() for f in futures]
+
+
+def _logging_call(fn: Callable[[int], T], i: int) -> T:
+    """Log-and-rethrow wrapper (LoggingCallable equivalent,
+    framework/oryx-common/.../lang/LoggingCallable.java)."""
+    try:
+        return fn(i)
+    except Exception:
+        log.exception("error in parallel task %d", i)
+        raise
+
+
+def map_in_parallel(items: Iterable[T], fn: Callable[[T], "T"], parallelism: int = 4) -> Iterator:
+    with cf.ThreadPoolExecutor(max_workers=parallelism) as pool:
+        yield from pool.map(fn, items)

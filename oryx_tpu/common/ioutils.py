@@ -1,0 +1,53 @@
+"""Filesystem + network IO helpers.
+
+Equivalent of the reference's IOUtils (framework/oryx-common/.../io/
+IOUtils.java:51-142): recursive delete, glob listing, free-port chooser for
+tests, close-quietly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob as _glob
+import os
+import shutil
+import socket
+from pathlib import Path
+
+
+def delete_recursively(path: str | Path) -> None:
+    p = Path(path)
+    if p.is_dir() and not p.is_symlink():
+        shutil.rmtree(p, ignore_errors=True)
+    elif p.exists() or p.is_symlink():
+        with contextlib.suppress(OSError):
+            p.unlink()
+
+
+def list_files(dir_path: str | Path, pattern: str = "*") -> list[Path]:
+    """Glob under dir_path, sorted; hidden files excluded (IOUtils.listFiles)."""
+    results = [
+        Path(p)
+        for p in _glob.glob(str(Path(dir_path) / pattern))
+        if not os.path.basename(p).startswith(".")
+    ]
+    return sorted(results)
+
+
+def choose_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def close_quietly(closeable) -> None:
+    if closeable is not None:
+        with contextlib.suppress(Exception):
+            closeable.close()
+
+
+def mkdirs(path: str | Path) -> Path:
+    p = Path(path)
+    p.mkdir(parents=True, exist_ok=True)
+    return p

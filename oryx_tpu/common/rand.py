@@ -1,0 +1,63 @@
+"""Central RNG management with a test-determinism switch.
+
+Equivalent of the reference's RandomManager (framework/oryx-common/.../random/
+RandomManager.java:51-97): all framework randomness flows through here so tests
+can flip one switch and become deterministic. Handed-out generators are tracked
+weakly and reseeded *in place* (via bit_generator state assignment), so callers
+that cached a generator become deterministic too — mirroring the reference's
+in-place ``random.setSeed`` over a softly-referenced collection. TPU addition:
+``get_key()`` hands out jax PRNG keys split from a managed root key, so
+device-side randomness is governed by the same switch.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+
+_TEST_SEED = 1234567890123456789 & 0xFFFFFFFF
+
+_lock = threading.Lock()
+_use_test_seed = False
+_instances: "weakref.WeakSet[np.random.Generator]" = weakref.WeakSet()
+_jax_key_counter = 0
+
+
+class _Generator(np.random.Generator):
+    """np.random.Generator is not weakref-able; this subclass is, letting the
+    registry hold instances weakly (the reference uses soft references)."""
+
+
+def use_test_seed() -> None:
+    """Switch all RNGs (existing and future) to a fixed seed — tests only."""
+    global _use_test_seed, _jax_key_counter
+    with _lock:
+        _use_test_seed = True
+        _jax_key_counter = 0
+        for gen in _instances:
+            gen.bit_generator.state = np.random.PCG64(_TEST_SEED).state
+
+
+def get_random(seed: int | None = None) -> np.random.Generator:
+    """A new host RNG; seeded deterministically iff use_test_seed() was called
+    (or an explicit seed is given)."""
+    with _lock:
+        if seed is not None:
+            return np.random.default_rng(seed)
+        g = _Generator(np.random.PCG64(_TEST_SEED if _use_test_seed else None))
+        _instances.add(g)
+        return g
+
+
+def get_key():
+    """A fresh jax PRNG key under the same determinism switch."""
+    import jax
+
+    global _jax_key_counter
+    with _lock:
+        if _use_test_seed:
+            _jax_key_counter += 1
+            return jax.random.key(_TEST_SEED + _jax_key_counter)
+        return jax.random.key(int(np.random.SeedSequence().entropy & 0x7FFFFFFF))

@@ -1,0 +1,162 @@
+"""Canonical default configuration for the TPU-native framework.
+
+Mirrors the reference's two reference.conf files
+(framework/oryx-common/src/main/resources/reference.conf:14-289 and
+app/oryx-app-common/src/main/resources/reference.conf:16-157) with the same
+``oryx.*`` key structure for the user-facing surface, and TPU-native
+infrastructure keys where the reference had Spark/Kafka/YARN knobs:
+
+  * ``*-topic.broker`` selects a transport backend (``memory:`` in-process,
+    ``file:<dir>`` durable log) instead of a Kafka broker address.
+  * ``batch/speed.streaming`` keeps ``generation-interval-sec`` (the microbatch
+    clock) and replaces executor sizing with mesh sizing (``mesh-shape``,
+    ``mesh-axes``) for the pjit'd compute tier.
+  * storage dirs are plain paths handled by the DataStore (HDFS equivalent).
+"""
+
+REFERENCE_CONF = """
+oryx = {
+  # Unique instance id; keys consumer-offset persistence so restarted layers
+  # resume from where they left off (reference reference.conf:16-20).
+  id = null
+
+  input-topic = {
+    broker = "memory:"
+    lock = { master = "memory:" }
+    message = {
+      topic = "OryxInput"
+      key-class = "str"
+      message-class = "str"
+    }
+  }
+
+  update-topic = {
+    broker = "memory:"
+    lock = { master = "memory:" }
+    message = {
+      topic = "OryxUpdate"
+      # Max message size; larger models are published by reference
+      # (MODEL-REF) instead of inline (reference reference.conf:78).
+      max-size = 16777216
+    }
+  }
+
+  # Default compute-tier settings shared by batch and speed
+  # (replaces oryx.default-streaming-config Spark knobs).
+  default-compute-config = {
+    platform = null            # null = let jax pick; or "cpu"/"tpu"
+    mesh-shape = null          # e.g. [4, 2]; null = all local devices on one axis
+    mesh-axes = ["data", "model"]
+    matmul-precision = "bfloat16"
+  }
+
+  batch = {
+    streaming = {
+      generation-interval-sec = 21600
+      config = ${oryx.default-compute-config}
+    }
+    update-class = null
+    storage = {
+      data-dir = "/tmp/OryxTPU/data/"
+      model-dir = "/tmp/OryxTPU/model/"
+      key-writable-class = "str"
+      message-writable-class = "str"
+      max-age-data-hours = -1
+      max-age-model-hours = -1
+    }
+    ui = { port = 4040 }
+  }
+
+  speed = {
+    streaming = {
+      generation-interval-sec = 10
+      config = ${oryx.default-compute-config}
+    }
+    model-manager-class = null
+    min-model-load-fraction = 0.8
+    ui = { port = 4040 }
+  }
+
+  serving = {
+    memory = "4000m"
+    api = {
+      port = 8080
+      secure-port = 8443
+      user-name = null
+      password = null
+      keystore-file = null
+      keystore-password = null
+      key-alias = null
+      read-only = false
+      context-path = "/"
+    }
+    application-resources = null
+    model-manager-class = null
+    min-model-load-fraction = 0.8
+    no-init-topics = false
+  }
+
+  ml = {
+    eval = {
+      test-fraction = 0.1
+      candidates = 1
+      hyperparam-search = "random"
+      parallelism = 1
+      threshold = null
+    }
+  }
+
+  # ----- app tier (reference app/oryx-app-common reference.conf) -----
+
+  als = {
+    iterations = 10
+    implicit = true
+    logStrength = false
+    hyperparams = {
+      features = 10
+      lambda = 0.001
+      alpha = 1.0
+      epsilon = 0.00001
+    }
+    no-known-items = false
+    rescorer-provider-class = null
+    decay = {
+      factor = 1.0
+      zero-threshold = 0.0
+    }
+    # Fraction of item vectors scanned per top-N query (LSH-equivalent knob).
+    sample-rate = 1.0
+  }
+
+  kmeans = {
+    iterations = 30
+    initialization-strategy = "k-means||"
+    evaluation-strategy = "SILHOUETTE"
+    runs = 3
+    hyperparams = {
+      k = 10
+    }
+  }
+
+  rdf = {
+    num-trees = 20
+    hyperparams = {
+      min-node-size = 16
+      min-info-gain-nats = 0.001
+      max-split-candidates = 100
+      max-depth = 8
+      impurity = "entropy"
+    }
+  }
+
+  input-schema = {
+    feature-names = []
+    num-features = 0
+    id-features = []
+    ignored-features = []
+    numeric-features = null
+    categorical-features = null
+    target-feature = null
+  }
+}
+"""

@@ -1,0 +1,72 @@
+"""Text/JSON codecs for topic messages and model files.
+
+Equivalent of the reference's TextUtils (framework/oryx-common/.../text/
+TextUtils.java:56-189): delimited (CSV-style, RFC-4180 quoting) and JSON-array
+line formats. Input lines may be either; ``parse_delimited`` handles quotes and
+escapes, ``parse_json_array`` parses a JSON array into string tokens.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Any, Sequence
+
+
+def parse_delimited(line: str, delimiter: str = ",") -> list[str]:
+    reader = csv.reader(io.StringIO(line), delimiter=delimiter, quotechar='"')
+    row = next(reader, [])
+    return list(row)
+
+
+def parse_csv(line: str) -> list[str]:
+    return parse_delimited(line, ",")
+
+
+def parse_json_array(line: str) -> list[str]:
+    arr = json.loads(line)
+    if not isinstance(arr, list):
+        raise ValueError(f"not a JSON array: {line!r}")
+    return [_tok(v) for v in arr]
+
+
+def _tok(v: Any) -> str:
+    if isinstance(v, str):
+        return v
+    return json.dumps(v)
+
+
+def join_delimited(values: Sequence[Any], delimiter: str = ",") -> str:
+    buf = io.StringIO()
+    writer = csv.writer(buf, delimiter=delimiter, quotechar='"', lineterminator="")
+    writer.writerow(["" if v is None else v for v in values])
+    return buf.getvalue()
+
+
+def join_json(values: Sequence[Any]) -> str:
+    return json.dumps(list(values), separators=(",", ":"))
+
+
+def read_json(s: str, cls: type | None = None) -> Any:
+    v = json.loads(s)
+    if cls is not None and not isinstance(v, cls):
+        raise ValueError(f"expected {cls.__name__}, got {type(v).__name__}")
+    return v
+
+
+def convert_via_json(value: Any, cls: type) -> Any:
+    """Round-trip an object through JSON to coerce its type (TextUtils.convertViaJSON)."""
+    v = json.loads(json.dumps(value))
+    if cls in (int, float, str, bool):
+        return cls(v)
+    return v
+
+
+def parse_possibly_json(line: str) -> list[str]:
+    """Input topic lines may be CSV or a JSON array; sniff and parse
+    (mirrors MLFunctions.PARSE_FN, app/oryx-app-common/.../fn/MLFunctions.java)."""
+    stripped = line.strip()
+    if stripped.startswith("["):
+        return parse_json_array(stripped)
+    return parse_csv(line)

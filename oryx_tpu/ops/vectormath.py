@@ -1,0 +1,104 @@
+"""Vector/matrix math kernel, jnp-based.
+
+Equivalent of the reference's VectorMath (framework/oryx-common/.../math/
+VectorMath.java:38-128): dot, norm, cosine similarity, Gramian (X^T X), random
+unit vectors. The reference's hot spot — the packed BLAS ``dspr`` rank-1
+accumulation in ``transposeTimesSelf`` — becomes a single ``X.T @ X`` matmul so
+XLA can tile it onto the MXU; callers batch rows into one array instead of
+looping vectors.
+
+Functions accept numpy or jax arrays and stay functional (no in-place state);
+everything is float32 by default (the reference stores float[] factors).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dot(x, y):
+    """Dot product (VectorMath.dot, VectorMath.java:38)."""
+    return jnp.dot(jnp.asarray(x), jnp.asarray(y))
+
+
+def norm(x):
+    """L2 norm (VectorMath.norm, VectorMath.java:49)."""
+    return jnp.linalg.norm(jnp.asarray(x))
+
+
+def cosine_similarity(x, y, norm_y=None):
+    """Cosine similarity; optionally with precomputed ||y||
+    (VectorMath.cosineSimilarity, VectorMath.java:79)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    ny = jnp.linalg.norm(y) if norm_y is None else norm_y
+    return jnp.dot(x, y) / (jnp.linalg.norm(x) * ny)
+
+
+@jax.jit
+def _gramian(x):
+    xf = x.astype(jnp.float32)
+    return xf.T @ xf
+
+
+def transpose_times_self(rows) -> jnp.ndarray | None:
+    """Gramian X^T X of a collection/array of row vectors
+    (VectorMath.transposeTimesSelf, VectorMath.java:94-110 — there a packed
+    ``dspr`` loop; here one MXU matmul). Returns None for empty input, matching
+    the reference's null return."""
+    if rows is None:
+        return None
+    if not isinstance(rows, (np.ndarray, jnp.ndarray)):
+        rows = list(rows)
+        if not rows:
+            return None
+        rows = np.asarray(rows, dtype=np.float32)
+    if rows.size == 0:
+        return None
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    return _gramian(jnp.asarray(rows))
+
+
+def random_vector_f(features: int, rng: np.random.Generator) -> np.ndarray:
+    """Random unit vector (VectorMath.randomVectorF, VectorMath.java:128)."""
+    v = rng.standard_normal(features).astype(np.float32)
+    n = np.linalg.norm(v)
+    if n == 0:
+        return random_vector_f(features, rng)
+    return v / n
+
+
+def parse_vector(tokens) -> np.ndarray:
+    """float[] from string tokens (VectorMath.parseVector)."""
+    return np.asarray([float(t) for t in tokens], dtype=np.float32)
+
+
+class DoubleWeightedMean:
+    """Streaming weighted mean (math/DoubleWeightedMean.java). Host-side;
+    used by evaluation aggregation."""
+
+    def __init__(self):
+        self._count = 0
+        self._total_weight = 0.0
+        self._mean = 0.0
+
+    def increment(self, value: float, weight: float = 1.0) -> None:
+        if weight <= 0:
+            raise ValueError("weight must be positive")
+        self._count += 1
+        self._total_weight += weight
+        self._mean += (weight / self._total_weight) * (value - self._mean)
+
+    @property
+    def result(self) -> float:
+        return self._mean if self._count else float("nan")
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"DoubleWeightedMean({self.result})"

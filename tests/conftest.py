@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU platform before jax import.
+
+Stands in for the reference's no-cluster IT strategy (LocalKafkaBroker +
+spark.master=local[3], SURVEY §4): multi-chip sharding is exercised on host
+CPU devices via --xla_force_host_platform_device_count.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _test_seed():
+    from oryx_tpu.common import rand
+
+    rand.use_test_seed()
+    yield

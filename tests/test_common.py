@@ -1,0 +1,121 @@
+"""Kernel utility tests (mirrors reference ClassUtilsTest, ExecUtilsTest,
+TextUtilsTest, AutoLockTest, RateLimitCheckTest, RandomManagerTest)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import classutils, executils, rand, textutils
+from oryx_tpu.common.lockutils import AutoLock, AutoReadWriteLock, RateLimitCheck
+
+
+# -- classutils ----------------------------------------------------------
+
+
+def test_load_class_and_instance():
+    cls = classutils.load_class("oryx_tpu.common.config.Config")
+    assert cls.__name__ == "Config"
+    inst = classutils.load_instance_of("oryx_tpu.common.config.Config")
+    assert inst is not None
+    assert classutils.class_exists("oryx_tpu.common.config.Config")
+    assert not classutils.class_exists("oryx_tpu.common.config.Nope")
+    with pytest.raises(ValueError):
+        classutils.load_class("NotQualified")
+
+
+class _TakesConfig:
+    def __init__(self, config):
+        self.config = config
+
+
+def test_load_instance_with_ctor_arg():
+    inst = classutils.load_instance_of(f"{__name__}._TakesConfig", None, {"k": 1})
+    assert inst.config == {"k": 1}
+
+
+# -- executils -----------------------------------------------------------
+
+
+def test_collect_in_parallel_ordered():
+    out = executils.collect_in_parallel(8, lambda i: i * i, parallelism=3)
+    assert out == [i * i for i in range(8)]
+
+
+def test_collect_in_parallel_propagates_errors():
+    def boom(i):
+        if i == 2:
+            raise RuntimeError("x")
+        return i
+
+    with pytest.raises(RuntimeError):
+        executils.collect_in_parallel(4, boom, parallelism=2)
+
+
+# -- textutils -----------------------------------------------------------
+
+
+def test_csv_roundtrip_with_quoting():
+    line = textutils.join_delimited(["a", 'b,"c', 1.5])
+    assert textutils.parse_csv(line) == ["a", 'b,"c', "1.5"]
+
+
+def test_json_array():
+    assert textutils.parse_json_array('["x", 1, [2]]') == ["x", "1", "[2]"]
+    assert textutils.join_json(["x", 1]) == '["x",1]'
+
+
+def test_parse_possibly_json():
+    assert textutils.parse_possibly_json("a,b,c") == ["a", "b", "c"]
+    assert textutils.parse_possibly_json('["a","b"]') == ["a", "b"]
+
+
+# -- rand ---------------------------------------------------------------
+
+
+def test_test_seed_is_deterministic():
+    rand.use_test_seed()
+    a = rand.get_random().standard_normal(4)
+    rand.use_test_seed()
+    b = rand.get_random().standard_normal(4)
+    np.testing.assert_array_equal(a, b)
+
+
+# -- locks --------------------------------------------------------------
+
+
+def test_auto_lock():
+    lock = AutoLock()
+    with lock:
+        pass  # reentrant acquisition would deadlock; just verify ARM usage
+
+
+def test_rw_lock_allows_concurrent_readers_blocks_writer():
+    lock = AutoReadWriteLock()
+    order = []
+
+    def reader():
+        with lock.read():
+            order.append("r-in")
+            time.sleep(0.05)
+            order.append("r-out")
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)
+    with lock.write():
+        order.append("w")
+    for t in threads:
+        t.join()
+    assert order.index("w") > order.index("r-out")
+    assert order.count("r-in") == 2
+
+
+def test_rate_limit_check():
+    rl = RateLimitCheck(0.2)
+    assert rl.test()
+    assert not rl.test()
+    time.sleep(0.25)
+    assert rl.test()

@@ -1,0 +1,81 @@
+"""Config system tests (mirrors reference ConfigUtilsTest / ConfigToPropertiesTest)."""
+
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common.config import Config, ConfigError
+
+
+def test_default_has_reference_keys():
+    c = cfg.get_default()
+    assert c.get("oryx.id") is None
+    assert c.get_string("oryx.input-topic.message.topic") == "OryxInput"
+    assert c.get_int("oryx.update-topic.message.max-size") == 16777216
+    assert c.get_int("oryx.batch.streaming.generation-interval-sec") == 21600
+    assert c.get_int("oryx.speed.streaming.generation-interval-sec") == 10
+    assert c.get_float("oryx.serving.min-model-load-fraction") == 0.8
+    assert c.get_float("oryx.ml.eval.test-fraction") == 0.1
+    assert c.get_int("oryx.als.hyperparams.features") == 10
+    assert c.get_bool("oryx.als.implicit") is True
+    assert c.get_string("oryx.kmeans.initialization-strategy") == "k-means||"
+    assert c.get_int("oryx.rdf.num-trees") == 20
+    assert c.get_list("oryx.input-schema.feature-names") == []
+
+
+def test_substitution_resolved_in_defaults():
+    c = cfg.get_default()
+    # batch.streaming.config = ${oryx.default-compute-config}
+    assert c.get("oryx.batch.streaming.config.mesh-axes") == ["data", "model"]
+
+
+def test_parse_hocon_subset():
+    c = Config.parse_string(
+        """
+        # comment
+        a.b = 1
+        a { c = "two", d = [1, 2, 3] } // trailing
+        e = true
+        f = 1.5
+        g = null
+        h = unquoted string
+        """
+    )
+    assert c.get_int("a.b") == 1
+    assert c.get_string("a.c") == "two"
+    assert c.get_list("a.d") == [1, 2, 3]
+    assert c.get_bool("e") is True
+    assert c.get_float("f") == 1.5
+    assert c.get("g") is None
+    assert c.get_string("h") == "unquoted string"
+
+
+def test_overlay_and_serialize():
+    base = cfg.get_default()
+    over = Config.from_dict({"oryx.als.hyperparams.features": 25, "oryx.id": "test"})
+    merged = over.overlay_on(base)
+    assert merged.get_int("oryx.als.hyperparams.features") == 25
+    assert merged.get_string("oryx.id") == "test"
+    # untouched keys survive
+    assert merged.get_bool("oryx.als.implicit") is True
+    rt = Config.deserialize(merged.serialize())
+    assert rt.get_int("oryx.als.hyperparams.features") == 25
+
+
+def test_missing_key_raises_and_default():
+    c = cfg.get_default()
+    with pytest.raises(ConfigError):
+        c.get("oryx.nope.nothing")
+    assert c.get("oryx.nope.nothing", 42) == 42
+
+
+def test_pretty_print_redacts_secrets():
+    c = Config.from_dict({"oryx.serving.api.password": "hunter2", "oryx.id": "x"})
+    printed = c.pretty_print()
+    assert "hunter2" not in printed
+    assert "*****" in printed
+
+
+def test_to_properties():
+    c = Config.from_dict({"oryx.a": 1, "oryx.b.c": "x", "other.y": 2})
+    props = c.to_properties()
+    assert props == {"oryx.a": "1", "oryx.b.c": "x"}

@@ -1,0 +1,99 @@
+"""Math kernel tests (mirrors reference VectorMathTest, LinearSystemSolverTest,
+DoubleWeightedMeanTest, SolverCacheTest)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import rand
+from oryx_tpu.ops import solver as solver_mod
+from oryx_tpu.ops import vectormath as vm
+from oryx_tpu.ops.solver import SingularMatrixSolverException, SolverCache
+
+
+def test_dot_norm_cosine():
+    x = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    y = np.array([4.0, 5.0, 6.0], dtype=np.float32)
+    assert float(vm.dot(x, y)) == pytest.approx(32.0)
+    assert float(vm.norm(x)) == pytest.approx(np.sqrt(14.0))
+    assert float(vm.cosine_similarity(x, y)) == pytest.approx(
+        32.0 / (np.sqrt(14.0) * np.sqrt(77.0)), rel=1e-6
+    )
+    # precomputed normY variant
+    assert float(vm.cosine_similarity(x, y, norm_y=np.sqrt(77.0))) == pytest.approx(
+        32.0 / (np.sqrt(14.0) * np.sqrt(77.0)), rel=1e-6
+    )
+
+
+def test_transpose_times_self():
+    rows = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    g = np.asarray(vm.transpose_times_self(rows))
+    np.testing.assert_allclose(g, rows.T @ rows, rtol=1e-5)
+    assert vm.transpose_times_self([]) is None
+    assert vm.transpose_times_self(None) is None
+
+
+def test_random_vector_unit_norm():
+    rng = rand.get_random()
+    v = vm.random_vector_f(37, rng)
+    assert v.shape == (37,)
+    assert np.linalg.norm(v) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_weighted_mean():
+    m = vm.DoubleWeightedMean()
+    assert np.isnan(m.result)
+    m.increment(1.0, 1.0)
+    m.increment(3.0, 3.0)
+    assert m.result == pytest.approx(2.5)
+    assert m.count == 2
+
+
+def test_solver_solves():
+    rng = rand.get_random()
+    a = rng.standard_normal((6, 4)).astype(np.float32)
+    gram = a.T @ a + 0.1 * np.eye(4, dtype=np.float32)
+    s = solver_mod.get_solver(gram)
+    b = rng.standard_normal(4)
+    x = s.solve_d_to_d(b)
+    np.testing.assert_allclose(gram @ x, b, atol=1e-4)
+    # batched RHS
+    bs = rng.standard_normal((3, 4))
+    xs = s.solve_f_to_f(bs)
+    np.testing.assert_allclose(gram @ xs.T, bs.T, atol=1e-2)
+
+
+def test_singular_matrix_raises_with_apparent_rank():
+    m = np.zeros((3, 3))
+    m[0, 0] = 1.0
+    m[1, 1] = 1.0  # rank 2
+    with pytest.raises(SingularMatrixSolverException) as ei:
+        solver_mod.get_solver(m)
+    assert ei.value.apparent_rank == 2
+
+
+def test_solver_cache_single_flight_and_dirty():
+    calls = []
+    vecs = np.eye(3, dtype=np.float32) * 2.0
+
+    def compute():
+        calls.append(1)
+        return vecs.T @ vecs
+
+    cache = SolverCache(compute)
+    s1 = cache.get(blocking=True)
+    assert s1 is not None
+    n1 = len(calls)
+    # non-dirty get does not recompute
+    s2 = cache.get(blocking=True)
+    assert s2 is s1
+    assert len(calls) == n1
+    # dirty triggers recompute (async); poll for it
+    cache.set_dirty()
+    cache.compute_now()
+    import time
+
+    for _ in range(100):
+        if len(calls) > n1:
+            break
+        time.sleep(0.01)
+    assert len(calls) > n1
