@@ -1,0 +1,25 @@
+"""Key/message pair flowing through topics.
+
+Equivalent of the reference's KeyMessage/KeyMessageImpl
+(framework/oryx-api/.../KeyMessage.java:34-40, KeyMessageImpl.java).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Generic, TypeVar
+
+K = TypeVar("K")
+M = TypeVar("M")
+
+
+@dataclass(frozen=True)
+class KeyMessage(Generic[K, M]):
+    key: K
+    message: M
+
+    def get_key(self) -> K:
+        return self.key
+
+    def get_message(self) -> M:
+        return self.message

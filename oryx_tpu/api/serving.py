@@ -1,0 +1,68 @@
+"""Serving-tier SPI.
+
+Equivalent of the reference's ServingModelManager / ServingModel /
+OryxServingException (framework/oryx-api/.../serving/ServingModelManager.java:48-66,
+ServingModel.java, OryxServingException.java) plus the dispatch base
+AbstractServingModelManager.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator
+
+from oryx_tpu.api.keymessage import KeyMessage
+
+
+class ServingModel(abc.ABC):
+    @abc.abstractmethod
+    def get_fraction_loaded(self) -> float:
+        """Readiness gate in [0,1]; requests 503 until this passes the
+        configured min-model-load-fraction."""
+
+
+class OryxServingException(Exception):
+    """Status + message carrier mapped to HTTP error responses."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message or str(status))
+        self.status = status
+        self.message = message or str(status)
+
+
+class ServingModelManager(abc.ABC):
+    """Maintains the in-memory serving model from the update topic."""
+
+    def __init__(self, config=None):
+        self._config = config
+
+    @abc.abstractmethod
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        ...
+
+    def get_config(self):
+        return self._config
+
+    @abc.abstractmethod
+    def get_model(self) -> ServingModel | None:
+        ...
+
+    def is_read_only(self) -> bool:
+        cfg = self.get_config()
+        return bool(cfg and cfg.get_bool("oryx.serving.api.read-only", False))
+
+    def close(self) -> None:
+        pass
+
+
+class AbstractServingModelManager(ServingModelManager):
+    """Dispatches each consumed message to consume_key_message
+    (AbstractServingModelManager.java:88)."""
+
+    def consume(self, updates: Iterator[KeyMessage]) -> None:
+        for km in updates:
+            self.consume_key_message(km.key, km.message)
+
+    @abc.abstractmethod
+    def consume_key_message(self, key: str, message: str) -> None:
+        ...

@@ -1,0 +1,152 @@
+"""Shared layer base: config parsing, topic wiring, generation clock.
+
+Equivalent of the reference's AbstractSparkLayer
+(framework/oryx-lambda/.../AbstractSparkLayer.java:57-224): where that builds a
+JavaStreamingContext + Kafka direct DStream, this builds a ComputeContext
+(jax mesh) + a microbatch pump over the input topic that resumes from stored
+offsets keyed by ``oryx.id`` (buildInputDStream:208-211).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Sequence
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import classutils
+from oryx_tpu.parallel.mesh import ComputeContext
+from oryx_tpu.transport import topic as tp
+
+log = logging.getLogger(__name__)
+
+
+class AbstractLayer:
+    def __init__(self, config, tier: str):
+        self.config = config
+        self.tier = tier
+        self.id = config.get_string("oryx.id", None)
+        self.input_broker = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+        self.update_broker = config.get_string("oryx.update-topic.broker")
+        self.update_topic = config.get_string("oryx.update-topic.message.topic")
+        self.update_max_size = config.get_int("oryx.update-topic.message.max-size")
+        self.generation_interval_sec = config.get_float(
+            f"oryx.{tier}.streaming.generation-interval-sec"
+        )
+        self._group = f"OryxGroup-{tier}-{self.id}" if self.id else None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._failure: BaseException | None = None
+        self._context: ComputeContext | None = None
+
+    # -- context ------------------------------------------------------------
+    def get_context(self) -> ComputeContext:
+        if self._context is None:
+            self._context = ComputeContext(self.config, self.tier)
+        return self._context
+
+    # -- topics -------------------------------------------------------------
+    def assert_topics(self) -> None:
+        """Topics must exist before starting (AbstractSparkLayer.java:178-185);
+        memory: brokers auto-create since there is no external setup CLI yet."""
+        for broker_url, name in (
+            (self.input_broker, self.input_topic),
+            (self.update_broker, self.update_topic),
+        ):
+            broker = tp.get_broker(broker_url)
+            if not broker.topic_exists(name):
+                if broker_url.startswith("memory:"):
+                    broker.create_topic(name)
+                else:
+                    raise tp.TopicException(
+                        f"topic {name} does not exist on {broker_url}; run topic-setup"
+                    )
+
+    def input_start_offset(self) -> int:
+        """Resume position: stored offset for this oryx.id, else latest."""
+        broker = tp.get_broker(self.input_broker)
+        if self._group:
+            stored = broker.get_offset(self._group, self.input_topic)
+            if stored is not None:
+                return stored
+        return broker.size(self.input_topic)
+
+    def store_input_offset(self, offset: int) -> None:
+        """Write back consumed offsets (UpdateOffsetsFn.java)."""
+        if self._group:
+            tp.get_broker(self.input_broker).set_offset(self._group, self.input_topic, offset)
+
+    # -- microbatch pump ----------------------------------------------------
+    def run_microbatches(
+        self,
+        on_batch: Callable[[int, Sequence[KeyMessage]], None],
+        interval_sec: float | None = None,
+        start_offset: int | None = None,
+    ) -> None:
+        """Every generation interval, hand the new input slice to on_batch —
+        the foreachRDD loop. Runs until stop; an on_batch exception is fatal
+        to the layer (reference fatal-on-error semantics).
+
+        ``start_offset`` should be resolved synchronously in start() (see
+        resolve_start_offset) so input produced after start() returns is never
+        skipped by a slow-to-schedule pump thread."""
+        interval = interval_sec if interval_sec is not None else self.generation_interval_sec
+        broker = tp.get_broker(self.input_broker)
+        offset = start_offset if start_offset is not None else self.input_start_offset()
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            if self._stop.is_set():
+                break
+            end = broker.size(self.input_topic)
+            batch: list[KeyMessage] = []
+            while offset < end:
+                chunk = broker.read(self.input_topic, offset, end - offset)
+                if not chunk:
+                    break
+                batch.extend(km for km in chunk if km is not tp.CORRUPT_RECORD)
+                offset += len(chunk)
+            timestamp_ms = int(time.time() * 1000)
+            on_batch(timestamp_ms, batch)
+            self.store_input_offset(offset)
+
+    # -- threads / lifecycle ------------------------------------------------
+    def spawn(self, name: str, fn: Callable[[], None]) -> threading.Thread:
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                if not self._stop.is_set():
+                    log.exception("fatal error in %s; closing layer", name)
+                    self._failure = e
+                    self._stop.set()
+
+        t = threading.Thread(target=run, name=name, daemon=True)
+        self._threads.append(t)
+        t.start()
+        return t
+
+    def load_manager_instance(self, class_key: str, expected_type=None):
+        """Reflectively load the configured user class, (config) ctor first
+        (BatchLayer.loadUpdateInstance:172-204 / SpeedLayer:160-192)."""
+        name = self.config.get_string(class_key)
+        if not name:
+            raise ValueError(f"no class configured at {class_key}")
+        return classutils.load_instance_of(name, expected_type, self.config)
+
+    def await_termination(self, timeout: float | None = None) -> None:
+        self._stop.wait(timeout)
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._failure is not None:
+            raise self._failure
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()

@@ -1,0 +1,69 @@
+"""Speed layer: incremental model updates on a short interval.
+
+Equivalent of the reference's SpeedLayer + SpeedLayerUpdate
+(framework/oryx-lambda/.../speed/SpeedLayer.java:52-194,
+SpeedLayerUpdate.java:51-63). Two concurrent activities:
+
+  * an update-consumer thread replaying the update topic from ``earliest``
+    into the SpeedModelManager (MODEL/MODEL-REF refresh + its own and the
+    batch layer's "UP" messages — the speed layer hears its own updates,
+    ALSSpeedModelManager.java:74-81);
+  * a microbatch pump that calls build_updates on each input slice and
+    publishes each update with key "UP" (async producer semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.api.speed import SpeedModelManager
+from oryx_tpu.lambda_rt.layer import AbstractLayer
+from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
+
+log = logging.getLogger(__name__)
+
+
+class SpeedLayer(AbstractLayer):
+    def __init__(self, config):
+        super().__init__(config, "speed")
+        self.model_manager: SpeedModelManager | None = None
+        self._update_iterator: ConsumeDataIterator | None = None
+        self._producer: TopicProducerImpl | None = None
+
+    def start(self, interval_sec: float | None = None) -> None:
+        self.assert_topics()
+        self.model_manager = self.load_manager_instance(
+            "oryx.speed.model-manager-class", SpeedModelManager
+        )
+        self._update_iterator = ConsumeDataIterator(
+            get_broker(self.update_broker), self.update_topic, "earliest"
+        )
+        self._producer = TopicProducerImpl(self.update_broker, self.update_topic)
+        log.info("starting speed layer; interval=%ss", interval_sec or self.generation_interval_sec)
+        # update-consumer thread (SpeedLayer.java:116-123)
+        self.spawn(
+            "OryxSpeedLayerUpdateConsumerThread",
+            lambda: self.model_manager.consume(self._update_iterator),
+        )
+        # per-microbatch updates (SpeedLayerUpdate)
+        start_offset = self.input_start_offset()
+        self.spawn(
+            "OryxSpeedLayer",
+            lambda: self.run_microbatches(self._on_microbatch, interval_sec, start_offset),
+        )
+
+    def _on_microbatch(self, timestamp_ms: int, new_data: Sequence[KeyMessage]) -> None:
+        if not new_data:
+            return
+        updates = self.model_manager.build_updates(new_data)
+        for update in updates:
+            self._producer.send("UP", update)
+
+    def close(self) -> None:
+        if self._update_iterator is not None:
+            self._update_iterator.close()
+        if self.model_manager is not None:
+            self.model_manager.close()
+        super().close()

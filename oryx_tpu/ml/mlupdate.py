@@ -1,0 +1,190 @@
+"""MLUpdate: the train/tune/eval/publish harness behind every model family.
+
+Equivalent of the reference's MLUpdate (framework/oryx-ml/.../MLUpdate.java:163-378):
+one batch generation = choose hyperparameter combos → build+evaluate candidates
+in parallel → promote the best into a timestamped model dir → publish MODEL
+(inline PMML when ≤ update-topic max-size) or MODEL-REF (path) → optional
+additional model data (e.g. ALS streams every factor row).
+
+TPU notes: candidate builds run through a host thread pool
+(``oryx.ml.eval.parallelism``, ExecUtils.collectInParallel:255 equivalent);
+each build is itself a pjit'd program on the mesh, so host threads only
+overlap orchestration and host↔device transfers of different candidates.
+"""
+
+from __future__ import annotations
+
+import logging
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Sequence
+
+from oryx_tpu.api.batch import BatchLayerUpdate
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import executils, rand
+from oryx_tpu.ml import param as hp
+from oryx_tpu.pmml import pmmlutils
+from oryx_tpu.store.datastore import ModelStore
+from oryx_tpu.transport.topic import TopicException
+
+log = logging.getLogger(__name__)
+
+MODEL_FILE_NAME = "model.pmml"  # MLUpdate.java MODEL_FILE_NAME
+
+
+class MLUpdate(BatchLayerUpdate):
+    """Subclasses implement build_model / evaluate (+ optional hooks)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.test_fraction = config.get_float("oryx.ml.eval.test-fraction")
+        candidates = config.get_int("oryx.ml.eval.candidates")
+        self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism")
+        self.threshold = config.get("oryx.ml.eval.threshold", None)
+        self.hyperparam_search = config.get_string("oryx.ml.eval.hyperparam-search")
+        self.max_message_size = config.get_int("oryx.update-topic.message.max-size")
+        if self.test_fraction == 0.0 and candidates > 1:
+            log.info("test-fraction = 0 so candidates is overridden to 1")
+            candidates = 1
+        self.candidates = candidates
+
+    # -- abstract surface (MLUpdate.java:113-157) ---------------------------
+    def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
+        return []
+
+    def build_model(
+        self,
+        context,
+        train_data: Sequence[KeyMessage],
+        hyper_parameters: list,
+        candidate_path: Path,
+    ):
+        """Train and return a PMML Element for one candidate."""
+        raise NotImplementedError
+
+    def evaluate(
+        self,
+        context,
+        model,  # PMML Element
+        model_parent_path: Path,
+        test_data: Sequence[KeyMessage],
+        train_data: Sequence[KeyMessage],
+    ) -> float:
+        """Higher is better (MLUpdate.java:157)."""
+        raise NotImplementedError
+
+    def publish_additional_model_data(
+        self, context, pmml, new_data, past_data, model_path: Path, producer
+    ) -> None:
+        """Hook (MLUpdate.java:139-146); default no-op."""
+
+    # -- BatchLayerUpdate (runUpdate:163-248) --------------------------------
+    def run_update(self, context, timestamp_ms, new_data, past_data, model_dir, producer):
+        all_data = list(new_data) + list(past_data)
+        if not all_data:
+            log.info("no data to train on")
+            return
+        combos = hp.choose_hyper_parameter_combos(
+            self.get_hyper_parameter_values(), self.candidates, self.hyperparam_search
+        )
+        train, test = self.split_new_data_to_train_test(all_data)
+        scratch = Path(tempfile.mkdtemp(prefix="oryx-candidates-"))
+        try:
+            best_path, best_eval = self._find_best_candidate_path(
+                context, train, test, combos, scratch
+            )
+            if best_path is None:
+                log.info("unable to build any model")
+                return
+            if self.threshold is not None and (
+                best_eval is None or best_eval < float(self.threshold)
+            ):
+                log.info(
+                    "best model eval %s does not exceed threshold %s; not publishing",
+                    best_eval,
+                    self.threshold,
+                )
+                return
+            # promote best candidate into the model store (MLUpdate.java:201-207)
+            store = ModelStore(model_dir)
+            final_path = store.promote(best_path, timestamp_ms)
+        finally:
+            # drop the whole candidates scratch (fs.delete(candidatesPath))
+            shutil.rmtree(scratch, ignore_errors=True)
+        model_file = final_path / MODEL_FILE_NAME
+        pmml = pmmlutils.read(model_file)
+        pmml_string = pmmlutils.to_string(pmml)
+        if producer is not None:
+            # inline if small enough, else by reference (MLUpdate.java:219-233)
+            if len(pmml_string) <= self.max_message_size:
+                producer.send("MODEL", pmml_string)
+            else:
+                producer.send("MODEL-REF", str(model_file))
+            self.publish_additional_model_data(
+                context, pmml, new_data, past_data, final_path, producer
+            )
+
+    # -- candidate search (findBestCandidatePath:250-292) --------------------
+    def _find_best_candidate_path(self, context, train, test, combos, scratch: Path):
+        def build_and_eval(i: int):
+            candidate_path = scratch / f"{i}"
+            candidate_path.mkdir(parents=True, exist_ok=True)
+            try:
+                pmml = self.build_model(context, train, combos[i], candidate_path)
+            except Exception:  # noqa: BLE001 - a failed candidate is skipped
+                log.exception("candidate %d failed to build", i)
+                return None
+            if pmml is None:
+                return None
+            pmmlutils.write(pmml, candidate_path / MODEL_FILE_NAME)
+            if self.test_fraction == 0.0 or not test:
+                eval_result = None
+            else:
+                eval_result = self.evaluate(context, pmml, candidate_path, test, train)
+            log.info("candidate %d (%s) eval = %s", i, combos[i], eval_result)
+            return candidate_path, eval_result
+
+        results = executils.collect_in_parallel(
+            len(combos), build_and_eval, self.eval_parallelism
+        )
+        best = None
+        for r in results:
+            if r is None:
+                continue
+            if best is None or _better(r[1], best[1]):
+                best = r
+        return best if best is not None else (None, None)
+
+    # -- train/test split (splitTrainTest:342-376) ---------------------------
+    def split_new_data_to_train_test(self, all_data):
+        """Default random split by test-fraction; subclasses may override with
+        e.g. time-ordered splits (ALSUpdate.java:326-343)."""
+        if self.test_fraction <= 0:
+            return all_data, []
+        rng = rand.get_random()
+        mask = rng.random(len(all_data)) < self.test_fraction
+        train = [d for d, m in zip(all_data, mask) if not m]
+        test = [d for d, m in zip(all_data, mask) if m]
+        return train, test
+
+
+def _better(a, b) -> bool:
+    if a is None:
+        return False
+    if b is None:
+        return True
+    return a > b
+
+
+def read_pmml_from_update_key_message(key: str, message: str):
+    """Decode MODEL / MODEL-REF update messages into a PMML Element
+    (AppPMMLUtils.readPMMLFromUpdateKeyMessage:234-259)."""
+    if key == "MODEL":
+        return pmmlutils.from_string(message)
+    if key == "MODEL-REF":
+        path = Path(message)
+        if not path.exists():
+            raise TopicException(f"MODEL-REF path does not exist: {message}")
+        return pmmlutils.read(path)
+    raise ValueError(f"not a model message: {key}")

@@ -1,0 +1,453 @@
+"""Topic transport: the framework's data plane.
+
+TPU-native replacement for the reference's Kafka/ZooKeeper messaging layer
+(framework/kafka-util/.../KafkaUtils.java:63-188 and
+ConsumeDataIterator.java:30-77). Two backends behind one URL scheme:
+
+  * ``memory:`` — in-process broker (a process-wide registry of append-only
+    logs with condition-variable wakeup). The default for tests and
+    single-process deployments, standing in for the reference ITs'
+    LocalKafkaBroker.
+  * ``file:<dir>`` — durable broker: each topic is an append-only JSONL log
+    on disk, readable by other processes on the same filesystem; offsets are
+    line indices. This is the host-side pub-sub that rides shared storage —
+    cross-host deployments point it at a network filesystem (DCN transport),
+    while device-side collectives stay inside pjit programs.
+
+Semantics kept from the reference:
+  * topics are append-only logs; consumers track offsets; layers persist
+    consumed positions through the broker's OffsetStore *after* processing
+    each batch (UpdateOffsetsFn semantics — see AbstractLayer), keyed by
+    ``oryx.id``;
+  * consuming from ``earliest`` replays the whole log (how speed/serving
+    rebuild model state, SpeedLayer.java:108-110);
+  * a blocking consume iterator with exponential poll backoff 1→1000 ms and
+    wakeup-based close (ConsumeDataIterator.java:30-77);
+  * producers enforce a transport-level max message size (Kafka
+    max.request.size = 1<<26); topics support prefix truncation in lieu of
+    Kafka retention.
+
+FileBroker writes each record as one O_APPEND write syscall (atomic between
+cooperating local processes; NFS append atomicity is not guaranteed — use one
+writer per topic there) and tolerates a partial trailing line from an
+in-flight writer by stopping before it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Iterator
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import ioutils
+
+
+class TopicException(Exception):
+    pass
+
+
+#: Placeholder returned for a corrupt log record so offsets stay aligned;
+#: ConsumeDataIterator filters it out by identity.
+CORRUPT_RECORD = KeyMessage(None, None)
+
+
+# ---------------------------------------------------------------------------
+# Broker interface + registry
+# ---------------------------------------------------------------------------
+
+
+class Broker:
+    """create/delete/exists + log access for one transport endpoint
+    (KafkaUtils equivalent)."""
+
+    def create_topic(self, name: str, partitions: int = 1) -> None:
+        raise NotImplementedError
+
+    def delete_topic(self, name: str) -> None:
+        raise NotImplementedError
+
+    def topic_exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def append(self, topic: str, key, message) -> None:
+        raise NotImplementedError
+
+    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
+        raise NotImplementedError
+
+    def size(self, topic: str) -> int:
+        """Latest offset (number of messages ever appended)."""
+        raise NotImplementedError
+
+    def truncate(self, topic: str, before_offset: int) -> None:
+        """Drop messages below the given offset (retention stand-in). Offsets
+        are stable: reads below the new base return nothing."""
+        raise NotImplementedError
+
+    def wait_for_data(self, topic: str, offset: int, timeout: float, stop=None) -> None:
+        """Block until new data may exist, timeout elapses, or ``stop``
+        (a threading.Event) is set."""
+        if stop is not None:
+            stop.wait(timeout)
+        else:
+            time.sleep(timeout)
+
+    def wake(self, topic: str) -> None:
+        """Wake blocked wait_for_data callers (consumer.wakeup())."""
+
+    # offset store (ZK-equivalent control plane, KafkaUtils.java:120-188)
+    def get_offset(self, group: str, topic: str) -> int | None:
+        raise NotImplementedError
+
+    def set_offset(self, group: str, topic: str, offset: int) -> None:
+        raise NotImplementedError
+
+
+_memory_brokers: dict[str, "MemoryBroker"] = {}
+_memory_lock = threading.Lock()
+
+
+def get_broker(url: str) -> Broker:
+    """Resolve a broker from a config URL: ``memory:[name]`` or ``file:<dir>``."""
+    if url.startswith("memory:"):
+        name = url[len("memory:"):] or "default"
+        with _memory_lock:
+            b = _memory_brokers.get(name)
+            if b is None:
+                b = _memory_brokers[name] = MemoryBroker()
+            return b
+    if url.startswith("file:"):
+        return FileBroker(url[len("file:"):])
+    raise TopicException(f"unknown broker url: {url}")
+
+
+def reset_memory_brokers() -> None:
+    """Drop all in-process brokers (test isolation)."""
+    with _memory_lock:
+        _memory_brokers.clear()
+
+
+class _MemoryTopic:
+    __slots__ = ("log", "base", "cond")
+
+    def __init__(self):
+        self.log: list[KeyMessage] = []
+        self.base = 0  # offset of log[0]; advances on truncate
+        self.cond = threading.Condition()
+
+
+class MemoryBroker(Broker):
+    def __init__(self):
+        self._topics: dict[str, _MemoryTopic] = {}
+        self._offsets: dict[tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+
+    def _topic(self, name: str) -> _MemoryTopic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                raise TopicException(f"topic does not exist: {name}")
+            return t
+
+    def create_topic(self, name: str, partitions: int = 1) -> None:
+        with self._lock:
+            self._topics.setdefault(name, _MemoryTopic())
+
+    def delete_topic(self, name: str) -> None:
+        with self._lock:
+            self._topics.pop(name, None)
+
+    def topic_exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._topics
+
+    def append(self, topic: str, key, message) -> None:
+        t = self._topic(topic)
+        with t.cond:
+            t.log.append(KeyMessage(key, message))
+            t.cond.notify_all()
+
+    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
+        t = self._topic(topic)
+        with t.cond:
+            lo = max(offset - t.base, 0)
+            return t.log[lo:lo + max_items]
+
+    def size(self, topic: str) -> int:
+        t = self._topic(topic)
+        with t.cond:
+            return t.base + len(t.log)
+
+    def truncate(self, topic: str, before_offset: int) -> None:
+        t = self._topic(topic)
+        with t.cond:
+            drop = min(max(before_offset - t.base, 0), len(t.log))
+            if drop:
+                del t.log[:drop]
+                t.base += drop
+
+    def wait_for_data(self, topic: str, offset: int, timeout: float, stop=None) -> None:
+        t = self._topic(topic)
+        with t.cond:
+            if t.base + len(t.log) <= offset and not (stop is not None and stop.is_set()):
+                t.cond.wait(timeout)
+
+    def wake(self, topic: str) -> None:
+        try:
+            t = self._topic(topic)
+        except TopicException:
+            return
+        with t.cond:
+            t.cond.notify_all()
+
+    def get_offset(self, group: str, topic: str) -> int | None:
+        with self._lock:
+            return self._offsets.get((group, topic))
+
+    def set_offset(self, group: str, topic: str, offset: int) -> None:
+        with self._lock:
+            self._offsets[(group, topic)] = offset
+
+
+class FileBroker(Broker):
+    """Append-only JSONL log per topic under a directory.
+
+    Appends are single O_APPEND write syscalls, atomic between cooperating
+    processes on a local filesystem. Reads keep a per-topic byte index that
+    extends incrementally, so polling cost is O(new bytes), not O(log size).
+    A partial trailing line (in-flight writer) is left for the next read;
+    corrupt interior lines are skipped with a warning.
+    """
+
+    def __init__(self, root: str):
+        self._root = Path(root)
+        ioutils.mkdirs(self._root)
+        self._lock = threading.Lock()
+        # topic -> (line-start byte offsets incl. next-append position)
+        self._index: dict[str, list[int]] = {}
+
+    def _log_path(self, name: str) -> Path:
+        return self._root / name / "00000.jsonl"
+
+    def create_topic(self, name: str, partitions: int = 1) -> None:
+        p = self._log_path(name)
+        ioutils.mkdirs(p.parent)
+        p.touch(exist_ok=True)
+
+    def delete_topic(self, name: str) -> None:
+        ioutils.delete_recursively(self._root / name)
+        with self._lock:
+            self._index.pop(name, None)
+
+    def topic_exists(self, name: str) -> bool:
+        return self._log_path(name).exists()
+
+    def append(self, topic: str, key, message) -> None:
+        p = self._log_path(topic)
+        if not p.exists():
+            raise TopicException(f"topic does not exist: {topic}")
+        line = json.dumps({"k": key, "m": message}, separators=(",", ":")) + "\n"
+        fd = os.open(p, os.O_WRONLY | os.O_APPEND)
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+
+    def _refresh_index(self, topic: str) -> list[int]:
+        """Extend the line index over bytes appended since the last call."""
+        p = self._log_path(topic)
+        if not p.exists():
+            raise TopicException(f"topic does not exist: {topic}")
+        with self._lock:
+            idx = self._index.setdefault(topic, [0])
+            scanned = idx[-1]
+            file_size = p.stat().st_size
+            if file_size <= scanned:
+                return idx
+            with open(p, "rb") as f:
+                f.seek(scanned)
+                data = f.read(file_size - scanned)
+            pos = 0
+            while True:
+                nl = data.find(b"\n", pos)
+                if nl == -1:
+                    break  # partial trailing line stays unindexed
+                idx.append(scanned + nl + 1)
+                pos = nl + 1
+            return idx
+
+    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
+        idx = self._refresh_index(topic)
+        n = len(idx) - 1  # complete lines
+        if offset >= n:
+            return []
+        end = min(offset + max_items, n)
+        p = self._log_path(topic)
+        out: list[KeyMessage] = []
+        with open(p, "rb") as f:
+            f.seek(idx[offset])
+            blob = f.read(idx[end] - idx[offset])
+        for raw in blob.split(b"\n"):
+            if not raw.strip():
+                continue
+            try:
+                d = json.loads(raw)
+                out.append(KeyMessage(d["k"], d["m"]))
+            except (json.JSONDecodeError, KeyError):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "skipping corrupt record in topic %s", topic
+                )
+                out.append(CORRUPT_RECORD)  # keep offsets aligned
+        return out[: end - offset]
+
+    def size(self, topic: str) -> int:
+        return len(self._refresh_index(topic)) - 1
+
+    def truncate(self, topic: str, before_offset: int) -> None:
+        """Rewrite the log without the truncated prefix. Offsets shift to
+        0-based on disk but this broker instance keeps serving stable offsets
+        only for fresh reads; cross-process readers should truncate during
+        quiet periods (retention maintenance)."""
+        idx = self._refresh_index(topic)
+        n = len(idx) - 1
+        cut = min(max(before_offset, 0), n)
+        if cut == 0:
+            return
+        p = self._log_path(topic)
+        with open(p, "rb") as f:
+            f.seek(idx[cut])
+            rest = f.read()
+        tmp = p.with_suffix(".tmp")
+        tmp.write_bytes(rest)
+        tmp.replace(p)
+        with self._lock:
+            self._index.pop(topic, None)
+
+    def get_offset(self, group: str, topic: str) -> int | None:
+        p = self._root / ".offsets" / f"{group}__{topic}.json"
+        if not p.exists():
+            return None
+        return json.loads(p.read_text())["offset"]
+
+    def set_offset(self, group: str, topic: str, offset: int) -> None:
+        p = self._root / ".offsets" / f"{group}__{topic}.json"
+        ioutils.mkdirs(p.parent)
+        tmp = p.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"offset": offset}))
+        tmp.replace(p)
+
+
+# ---------------------------------------------------------------------------
+# Producer + consume iterator (TopicProducer / ConsumeDataIterator)
+# ---------------------------------------------------------------------------
+
+#: Fixed transport-level message cap (TopicProducerImpl.java sets Kafka
+#: max.request.size = 1<<26). The *configured* update-topic max-size only
+#: drives MLUpdate's inline-vs-MODEL-REF decision, not producer enforcement.
+MAX_REQUEST_SIZE = 1 << 26
+
+
+class TopicProducerImpl:
+    """Producer for one topic (framework/oryx-lambda/.../TopicProducerImpl.java).
+    Enforces the transport cap; oversized sends raise, and callers fall back to
+    the MODEL-REF by-reference protocol (ml/MLUpdate publish path)."""
+
+    def __init__(self, broker_url: str, topic: str, max_size: int | None = MAX_REQUEST_SIZE):
+        self._broker_url = broker_url
+        self._topic = topic
+        self._max_size = max_size
+        self._broker: Broker | None = None  # lazy, like the reference
+
+    def get_update_broker(self) -> str:
+        return self._broker_url
+
+    def get_topic(self) -> str:
+        return self._topic
+
+    def send(self, key, message) -> None:
+        if self._broker is None:
+            self._broker = get_broker(self._broker_url)
+        if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
+            raise TopicException(
+                f"message of {len(message)} bytes exceeds max {self._max_size}"
+            )
+        self._broker.append(self._topic, key, message)
+
+    def close(self) -> None:
+        self._broker = None
+
+
+class ConsumeDataIterator(Iterator[KeyMessage]):
+    """Blocking iterator over a topic from a starting offset, with exponential
+    poll backoff 1→1000 ms and wakeup-based close
+    (kafka-util/.../ConsumeDataIterator.java:30-77).
+
+    ``start_offset``: int offset, or "earliest" (0), or "latest" (current end).
+    Offset *persistence* is deliberately not done here: layers commit consumed
+    positions after processing (UpdateOffsetsFn semantics) via
+    Broker.set_offset.
+    """
+
+    _MIN_BACKOFF = 0.001
+    _MAX_BACKOFF = 1.0
+
+    def __init__(
+        self,
+        broker: Broker | str,
+        topic: str,
+        start_offset: "int | str" = "earliest",
+    ):
+        self._broker = get_broker(broker) if isinstance(broker, str) else broker
+        self._topic = topic
+        if start_offset == "earliest":
+            self._offset = 0
+        elif start_offset == "latest":
+            self._offset = self._broker.size(topic)
+        else:
+            self._offset = int(start_offset)
+        self._buffer: list[KeyMessage] = []
+        self._closed = threading.Event()
+
+    @property
+    def offset(self) -> int:
+        return self._offset
+
+    def __iter__(self) -> "ConsumeDataIterator":
+        return self
+
+    def __next__(self) -> KeyMessage:
+        backoff = self._MIN_BACKOFF
+        while not self._buffer:
+            if self._closed.is_set():
+                raise StopIteration
+            batch = self._broker.read(self._topic, self._offset)
+            if batch:
+                self._offset += len(batch)
+                self._buffer = [km for km in batch if km is not CORRUPT_RECORD]
+                if not self._buffer:
+                    continue
+                break
+            self._broker.wait_for_data(self._topic, self._offset, backoff, stop=self._closed)
+            backoff = min(backoff * 2, self._MAX_BACKOFF)
+        return self._buffer.pop(0)
+
+    def close(self) -> None:
+        """Wake up and terminate a blocked iteration (consumer.wakeup())."""
+        self._closed.set()
+        self._broker.wake(self._topic)
+
+
+def maybe_create_topics(config, *topic_keys: str) -> None:
+    """Assert/create the configured topics (AbstractSparkLayer.java:178-185 +
+    oryx-run.sh kafka-setup). topic_keys like 'input-topic', 'update-topic'."""
+    for tk in topic_keys:
+        broker = get_broker(config.get_string(f"oryx.{tk}.broker"))
+        name = config.get_string(f"oryx.{tk}.message.topic")
+        if not broker.topic_exists(name):
+            broker.create_topic(name)

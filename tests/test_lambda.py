@@ -1,0 +1,180 @@
+"""Lambda runtime ITs, in-process (mirrors reference BatchLayerIT / SpeedLayerIT /
+DeleteOldDataIT with LocalKafkaBroker + local[3], SURVEY §4.2)."""
+
+import time
+
+import pytest
+
+from oryx_tpu.api.batch import BatchLayerUpdate
+from oryx_tpu.api.speed import AbstractSpeedModelManager
+from oryx_tpu.common import config as cfg
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+from oryx_tpu.transport import topic as tp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    tp.reset_memory_brokers()
+    yield
+    tp.reset_memory_brokers()
+
+
+RECORDED = {}
+
+
+class MockBatchUpdate(BatchLayerUpdate):
+    """Records calls (reference MockBatchUpdate)."""
+
+    def __init__(self, config=None):
+        pass
+
+    def run_update(self, context, timestamp_ms, new_data, past_data, model_dir, producer):
+        RECORDED.setdefault("calls", []).append(
+            {
+                "ts": timestamp_ms,
+                "new": [km.message for km in new_data],
+                "past": [km.message for km in past_data],
+            }
+        )
+        producer.send("MODEL", f"model-at-{timestamp_ms}")
+
+
+class MockSpeedManager(AbstractSpeedModelManager):
+    def __init__(self, config=None):
+        self.consumed = []
+
+    def consume_key_message(self, key, message):
+        self.consumed.append((key, message))
+        RECORDED.setdefault("speed-consumed", []).append((key, message))
+
+    def build_updates(self, new_data):
+        return [f"count,{len(new_data)}"]
+
+
+def _conf(tmp_path, tier_class_key, clazz):
+    return cfg.overlay_on(
+        {
+            "oryx.id": "test",
+            tier_class_key: clazz,
+            "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+            "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+            "oryx.batch.streaming.config.platform": "cpu",
+            "oryx.speed.streaming.config.platform": "cpu",
+        },
+        cfg.get_default(),
+    )
+
+
+def test_batch_layer_end_to_end(tmp_path):
+    RECORDED.clear()
+    config = _conf(tmp_path, "oryx.batch.update-class", f"{__name__}.MockBatchUpdate")
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.2)
+    try:
+        producer.send("k1", "a,1")
+        producer.send("k2", "b,2")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not RECORDED.get("calls"):
+            time.sleep(0.05)
+        assert RECORDED.get("calls"), "batch update was never invoked"
+        first = RECORDED["calls"][0]
+        assert first["new"] == ["a,1", "b,2"]
+        assert first["past"] == []
+
+        # second generation sees first as past data
+        producer.send("k3", "c,3")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(RECORDED["calls"]) < 2:
+            time.sleep(0.05)
+        second = RECORDED["calls"][1]
+        assert second["new"] == ["c,3"]
+        assert sorted(second["past"]) == ["a,1", "b,2"]
+
+        # MODEL messages published to update topic
+        b = tp.get_broker("memory:")
+        updates = b.read("OryxUpdate", 0)
+        assert [km.key for km in updates][:2] == ["MODEL", "MODEL"]
+        # data persisted as segments
+        assert len(list(layer.data_store.segments())) == 2
+    finally:
+        layer.close()
+
+
+def test_batch_layer_skips_empty_generation(tmp_path):
+    RECORDED.clear()
+    config = _conf(tmp_path, "oryx.batch.update-class", f"{__name__}.MockBatchUpdate")
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.1)
+    try:
+        time.sleep(0.4)
+        assert not RECORDED.get("calls")
+    finally:
+        layer.close()
+
+
+def test_speed_layer_end_to_end(tmp_path):
+    RECORDED.clear()
+    config = _conf(tmp_path, "oryx.speed.model-manager-class", f"{__name__}.MockSpeedManager")
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    b = tp.get_broker("memory:")
+    # pre-load update topic with a model, like AbstractSpeedIT
+    tp.TopicProducerImpl("memory:", "OryxUpdate").send("MODEL", "mock-model")
+
+    layer = SpeedLayer(config)
+    layer.start(interval_sec=0.2)
+    try:
+        # manager consumed the pre-loaded model from earliest
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not RECORDED.get("speed-consumed"):
+            time.sleep(0.05)
+        assert ("MODEL", "mock-model") in RECORDED.get("speed-consumed", [])
+
+        # input microbatch produces an UP update
+        tp.TopicProducerImpl("memory:", "OryxInput").send("k", "x,1")
+        deadline = time.monotonic() + 5
+        up = None
+        while time.monotonic() < deadline and up is None:
+            msgs = b.read("OryxUpdate", 0)
+            ups = [km for km in msgs if km.key == "UP"]
+            up = ups[0] if ups else None
+            time.sleep(0.05)
+        assert up is not None and up.message == "count,1"
+        # speed layer hears its own UP (consumed via update thread)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and ("UP", "count,1") not in RECORDED["speed-consumed"]:
+            time.sleep(0.05)
+        assert ("UP", "count,1") in RECORDED["speed-consumed"]
+    finally:
+        layer.close()
+
+
+def test_offsets_resume_batch(tmp_path):
+    """Restarted layer with same oryx.id does not re-process consumed input."""
+    RECORDED.clear()
+    config = _conf(tmp_path, "oryx.batch.update-class", f"{__name__}.MockBatchUpdate")
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.15)
+    producer.send("k", "first")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not RECORDED.get("calls"):
+        time.sleep(0.05)
+    layer.close()
+    n_calls = len(RECORDED["calls"])
+
+    layer2 = BatchLayer(config)
+    layer2.start(interval_sec=0.15)
+    try:
+        producer.send("k", "second")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(RECORDED["calls"]) <= n_calls:
+            time.sleep(0.05)
+        newest = RECORDED["calls"][-1]
+        assert newest["new"] == ["second"]  # "first" not re-delivered as new
+    finally:
+        layer2.close()

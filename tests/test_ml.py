@@ -1,0 +1,215 @@
+"""ML tier tests (mirrors reference GridSearchTest, RandomSearchTest,
+HyperParamsTest, SimpleMLUpdateIT, ThresholdIT)."""
+
+import time
+
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import config as cfg
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.ml import param as hp
+from oryx_tpu.ml.mlupdate import MLUpdate, read_pmml_from_update_key_message
+from oryx_tpu.pmml import pmmlutils
+from oryx_tpu.transport import topic as tp
+
+
+# -- hyperparam DSL ------------------------------------------------------
+
+
+def test_continuous_range_trials():
+    r = hp.ContinuousRange(0.0, 1.0)
+    assert r.get_trial_values(1) == [0.5]
+    assert r.get_trial_values(2) == [0.0, 1.0]
+    assert r.get_trial_values(3) == [0.0, 0.5, 1.0]
+    assert hp.ContinuousRange(2.0, 2.0).get_trial_values(5) == [2.0]
+
+
+def test_discrete_range_trials():
+    r = hp.DiscreteRange(1, 10)
+    assert r.get_trial_values(100) == list(range(1, 11))
+    assert r.get_trial_values(2) == [1, 10]
+    assert r.get_num_distinct_values() == 10
+
+
+def test_unordered():
+    u = hp.Unordered(["a", "b", "c"])
+    assert u.get_trial_values(2) == ["a", "b"]
+    assert u.get_num_distinct_values() == 3
+
+
+def test_from_config():
+    c = cfg.cfg_mod = cfg.Config.from_dict(
+        {"h.fixed-int": 7, "h.fixed-float": 0.5, "h.range-int": [1, 5],
+         "h.range-float": [0.1, 0.9], "h.unordered": ["x", "y", "z"]}
+    )
+    assert isinstance(hp.from_config(c, "h.fixed-int"), hp.DiscreteRange)
+    assert isinstance(hp.from_config(c, "h.fixed-float"), hp.ContinuousRange)
+    assert isinstance(hp.from_config(c, "h.range-int"), hp.DiscreteRange)
+    assert isinstance(hp.from_config(c, "h.range-float"), hp.ContinuousRange)
+    assert isinstance(hp.from_config(c, "h.unordered"), hp.Unordered)
+    assert hp.from_config(c, "h.fixed-int").get_trial_values(3) == [7]
+
+
+def test_grid_search_counts():
+    ranges = [hp.DiscreteRange(1, 3), hp.Unordered(["a", "b"])]
+    combos = hp.choose_hyper_parameter_combos(ranges, 6, "grid")
+    assert len(combos) == 6
+    assert len({tuple(c) for c in combos}) == 6  # all distinct
+    # capped subset
+    combos2 = hp.choose_hyper_parameter_combos(ranges, 2, "grid")
+    assert len(combos2) == 2
+    # no params
+    assert hp.choose_hyper_parameter_combos([], 3, "grid") == [[]]
+
+
+def test_random_search_counts():
+    ranges = [hp.ContinuousRange(0, 1), hp.DiscreteRange(1, 100)]
+    combos = hp.choose_hyper_parameter_combos(ranges, 7, "random")
+    assert len(combos) == 7
+    for c in combos:
+        assert 0 <= c[0] <= 1 and 1 <= c[1] <= 100
+
+
+# -- PMML ---------------------------------------------------------------
+
+
+def test_pmml_roundtrip_and_extensions(tmp_path):
+    pmml = pmmlutils.build_skeleton_pmml()
+    pmmlutils.add_extension(pmml, "features", 25)
+    pmmlutils.add_extension_content(pmml, "XIDs", ["u1", "u 2", 'u"3'])
+    p = tmp_path / "model.pmml"
+    pmmlutils.write(pmml, p)
+    back = pmmlutils.read(p)
+    assert pmmlutils.get_extension_value(back, "features") == "25"
+    assert pmmlutils.get_extension_content(back, "XIDs") == ["u1", "u 2", 'u"3']
+    assert pmmlutils.get_extension_value(back, "nope") is None
+    # string round trip
+    s = pmmlutils.to_string(back)
+    again = pmmlutils.from_string(s)
+    assert pmmlutils.get_extension_value(again, "features") == "25"
+
+
+def test_pmml_delimited_quoting():
+    vals = ["plain", "has space", 'has"quote', ""]
+    joined = pmmlutils.join_pmml_delimited(vals)
+    assert pmmlutils.parse_pmml_delimited(joined) == vals
+
+
+# -- MLUpdate harness ----------------------------------------------------
+
+
+class MockMLUpdate(MLUpdate):
+    """Records train/test sizes, returns dummy PMML (reference MockMLUpdate)."""
+
+    train_counts = []
+    test_counts = []
+
+    def get_hyper_parameter_values(self):
+        return [hp.DiscreteRange(1, 3)]
+
+    def build_model(self, context, train_data, hyper_parameters, candidate_path):
+        MockMLUpdate.train_counts.append(len(train_data))
+        pmml = pmmlutils.build_skeleton_pmml()
+        pmmlutils.add_extension(pmml, "param", hyper_parameters[0])
+        return pmml
+
+    def evaluate(self, context, model, model_parent_path, test_data, train_data):
+        MockMLUpdate.test_counts.append(len(test_data))
+        # prefer larger param value, deterministic winner
+        return float(pmmlutils.get_extension_value(model, "param"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    tp.reset_memory_brokers()
+    yield
+    tp.reset_memory_brokers()
+
+
+def _ml_config(tmp_path, **extra):
+    base = {
+        "oryx.id": "mltest",
+        "oryx.batch.update-class": f"{__name__}.MockMLUpdate",
+        "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+        "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+        "oryx.batch.streaming.config.platform": "cpu",
+        "oryx.ml.eval.candidates": 3,
+        "oryx.ml.eval.parallelism": 2,
+        "oryx.ml.eval.hyperparam-search": "grid",
+    }
+    base.update(extra)
+    return cfg.overlay_on(base, cfg.get_default())
+
+
+def test_mlupdate_end_to_end(tmp_path):
+    MockMLUpdate.train_counts = []
+    MockMLUpdate.test_counts = []
+    config = _ml_config(tmp_path)
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.2)
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    try:
+        for i in range(100):
+            producer.send(str(i), f"data-{i}")
+        b = tp.get_broker("memory:")
+        deadline = time.monotonic() + 10
+        models = []
+        while time.monotonic() < deadline and not models:
+            models = [km for km in b.read("OryxUpdate", 0) if km.key == "MODEL"]
+            time.sleep(0.05)
+        assert models, "no MODEL published"
+        pmml = read_pmml_from_update_key_message("MODEL", models[0].message)
+        # grid over DiscreteRange(1,3) w/ 3 candidates; best param == 3 wins
+        assert pmmlutils.get_extension_value(pmml, "param") == "3"
+        # 3 candidates built; ~10% test split
+        assert len(MockMLUpdate.train_counts) == 3
+        total = MockMLUpdate.train_counts[0] + MockMLUpdate.test_counts[0]
+        assert total == 100
+        assert 0 < MockMLUpdate.test_counts[0] < 50
+        # model dir promoted
+        assert layer.model_store.latest() is not None
+        assert (layer.model_store.latest() / "model.pmml").exists()
+    finally:
+        layer.close()
+
+
+def test_threshold_blocks_publish(tmp_path):
+    config = _ml_config(tmp_path, **{"oryx.ml.eval.threshold": 1000.0})
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.2)
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    try:
+        for i in range(20):
+            producer.send(str(i), f"data-{i}")
+        time.sleep(1.0)
+        b = tp.get_broker("memory:")
+        assert not [km for km in b.read("OryxUpdate", 0) if km.key == "MODEL"]
+    finally:
+        layer.close()
+
+
+def test_model_ref_when_oversized(tmp_path):
+    config = _ml_config(
+        tmp_path, **{"oryx.update-topic.message.max-size": 10}  # force MODEL-REF
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    layer = BatchLayer(config)
+    layer.start(interval_sec=0.2)
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    try:
+        for i in range(20):
+            producer.send(str(i), f"data-{i}")
+        b = tp.get_broker("memory:")
+        deadline = time.monotonic() + 10
+        refs = []
+        while time.monotonic() < deadline and not refs:
+            refs = [km for km in b.read("OryxUpdate", 0) if km.key == "MODEL-REF"]
+            time.sleep(0.05)
+        assert refs, "no MODEL-REF published"
+        pmml = read_pmml_from_update_key_message("MODEL-REF", refs[0].message)
+        assert pmmlutils.get_extension_value(pmml, "param") is not None
+    finally:
+        layer.close()

@@ -1,0 +1,202 @@
+"""Transport + store tests (mirrors reference ProduceConsumeIT, KafkaUtilsIT,
+LargeMessageIT, DeleteOldDataIT — in-process, per SURVEY §4's port note)."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.store.datastore import DataStore, ModelStore
+from oryx_tpu.transport import topic as tp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    tp.reset_memory_brokers()
+    yield
+    tp.reset_memory_brokers()
+
+
+def _roundtrip(broker_url):
+    broker = tp.get_broker(broker_url)
+    broker.create_topic("T")
+    assert broker.topic_exists("T")
+    prod = tp.TopicProducerImpl(broker_url, "T")
+    for i in range(5):
+        prod.send(f"k{i}", f"m{i}")
+    it = tp.ConsumeDataIterator(broker, "T", "earliest")
+    got = [next(it) for _ in range(5)]
+    assert got == [KeyMessage(f"k{i}", f"m{i}") for i in range(5)]
+    it.close()
+    broker.delete_topic("T")
+    assert not broker.topic_exists("T")
+
+
+def test_memory_roundtrip():
+    _roundtrip("memory:")
+
+
+def test_file_roundtrip(tmp_path):
+    _roundtrip(f"file:{tmp_path}/broker")
+
+
+def test_blocking_consume_wakes_on_produce():
+    broker = tp.get_broker("memory:")
+    broker.create_topic("T")
+    it = tp.ConsumeDataIterator(broker, "T", "earliest")
+    got = []
+
+    def consume():
+        got.append(next(it))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    tp.TopicProducerImpl("memory:", "T").send("k", "v")
+    t.join(timeout=5)
+    assert got == [KeyMessage("k", "v")]
+
+
+def test_close_unblocks_consumer():
+    broker = tp.get_broker("memory:")
+    broker.create_topic("T")
+    it = tp.ConsumeDataIterator(broker, "T", "latest")
+    done = threading.Event()
+
+    def consume():
+        with pytest.raises(StopIteration):
+            next(it)
+        done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    it.close()
+    assert done.wait(timeout=5)
+
+
+def test_latest_skips_existing():
+    broker = tp.get_broker("memory:")
+    broker.create_topic("T")
+    tp.TopicProducerImpl("memory:", "T").send("old", "old")
+    it = tp.ConsumeDataIterator(broker, "T", "latest")
+    tp.TopicProducerImpl("memory:", "T").send("new", "new")
+    assert next(it).key == "new"
+
+
+def test_offsets_resume(tmp_path):
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    prod = tp.TopicProducerImpl(url, "T")
+    for i in range(4):
+        prod.send(str(i), str(i))
+    it = tp.ConsumeDataIterator(broker, "T", "earliest")
+    for _ in range(4):
+        next(it)
+    # consumer commits after processing (UpdateOffsetsFn semantics)
+    broker.set_offset("g1", "T", it.offset)
+    stored = broker.get_offset("g1", "T")
+    assert stored == 4
+    prod.send("4", "4")
+    it2 = tp.ConsumeDataIterator(broker, "T", stored)
+    assert next(it2).key == "4"
+
+
+def test_truncate_retention():
+    broker = tp.get_broker("memory:")
+    broker.create_topic("T")
+    prod = tp.TopicProducerImpl("memory:", "T")
+    for i in range(6):
+        prod.send(str(i), str(i))
+    broker.truncate("T", 4)
+    assert broker.size("T") == 6  # offsets stay stable
+    msgs = broker.read("T", 0)
+    assert [km.key for km in msgs] == ["4", "5"]
+    msgs = broker.read("T", 5)
+    assert [km.key for km in msgs] == ["5"]
+
+
+def test_file_broker_tolerates_partial_trailing_line(tmp_path):
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    tp.TopicProducerImpl(url, "T").send("a", "1")
+    # simulate an in-flight writer: partial line with no newline
+    log = tmp_path / "broker" / "T" / "00000.jsonl"
+    with open(log, "a") as f:
+        f.write('{"k":"b","m":"2')
+    assert broker.size("T") == 1
+    assert [km.key for km in broker.read("T", 0)] == ["a"]
+    # writer finishes the line
+    with open(log, "a") as f:
+        f.write('"}\n')
+    assert broker.size("T") == 2
+    assert [km.key for km in broker.read("T", 1)] == ["b"]
+
+
+def test_file_broker_skips_corrupt_interior_line(tmp_path):
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    prod = tp.TopicProducerImpl(url, "T")
+    prod.send("a", "1")
+    log = tmp_path / "broker" / "T" / "00000.jsonl"
+    with open(log, "a") as f:
+        f.write("NOT JSON AT ALL\n")
+    prod.send("c", "3")
+    it = tp.ConsumeDataIterator(broker, "T", "earliest")
+    assert next(it).key == "a"
+    assert next(it).key == "c"  # corrupt record silently skipped
+    assert it.offset == 3  # but offsets stay aligned
+
+
+def test_max_size_enforced():
+    broker = tp.get_broker("memory:")
+    broker.create_topic("T")
+    prod = tp.TopicProducerImpl("memory:", "T", max_size=10)
+    with pytest.raises(tp.TopicException):
+        prod.send("k", "x" * 100)
+    prod.send("k", "small")  # under limit fine
+
+
+def test_maybe_create_topics():
+    from oryx_tpu.common import config as cfg
+
+    c = cfg.get_default()
+    tp.maybe_create_topics(c, "input-topic", "update-topic")
+    b = tp.get_broker("memory:")
+    assert b.topic_exists("OryxInput") and b.topic_exists("OryxUpdate")
+
+
+# -- datastore ----------------------------------------------------------
+
+
+def test_datastore_write_read_gc(tmp_path):
+    ds = DataStore(str(tmp_path / "data"))
+    assert ds.write_segment(1000, []) is None  # empty interval skipped
+    ds.write_segment(1000, [KeyMessage("a", "1"), KeyMessage("b", "2")])
+    ds.write_segment(2000, [KeyMessage("c", "3")])
+    got = list(ds.read_all())
+    assert [km.key for km in got] == ["a", "b", "c"]
+    # GC with cutoff between segments
+    deleted = ds.delete_older_than(1, now_ms=2000 + 3600 * 1000)
+    assert len(deleted) == 1
+    assert [km.key for km in ds.read_all()] == ["c"]
+    # disabled GC
+    assert ds.delete_older_than(-1) == []
+
+
+def test_modelstore_promote_latest_gc(tmp_path):
+    ms = ModelStore(str(tmp_path / "model"))
+    cand = tmp_path / "cand"
+    cand.mkdir()
+    (cand / "model.pmml").write_text("<PMML/>")
+    d1 = ms.promote(cand, 1000)
+    assert (d1 / "model.pmml").exists()
+    d2 = ms.new_model_dir(2000)
+    assert ms.latest() == d2
+    deleted = ms.delete_older_than(1, now_ms=2000 + 3600 * 1000)
+    assert deleted == [d1]
+    assert ms.model_dirs() == [d2]
