@@ -31,7 +31,6 @@ class AbstractLayer:
         self.input_topic = config.get_string("oryx.input-topic.message.topic")
         self.update_broker = config.get_string("oryx.update-topic.broker")
         self.update_topic = config.get_string("oryx.update-topic.message.topic")
-        self.update_max_size = config.get_int("oryx.update-topic.message.max-size")
         self.generation_interval_sec = config.get_float(
             f"oryx.{tier}.streaming.generation-interval-sec"
         )

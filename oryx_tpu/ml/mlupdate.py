@@ -81,14 +81,18 @@ class MLUpdate(BatchLayerUpdate):
 
     # -- BatchLayerUpdate (runUpdate:163-248) --------------------------------
     def run_update(self, context, timestamp_ms, new_data, past_data, model_dir, producer):
-        all_data = list(new_data) + list(past_data)
-        if not all_data:
+        new_data = list(new_data)
+        past_data = list(past_data)
+        if not new_data and not past_data:
             log.info("no data to train on")
             return
         combos = hp.choose_hyper_parameter_combos(
             self.get_hyper_parameter_values(), self.candidates, self.hyperparam_search
         )
-        train, test = self.split_new_data_to_train_test(all_data)
+        # test data is held out of NEW data only; past data always trains
+        # (MLUpdate.java:306,342-376)
+        train_new, test = self.split_new_data_to_train_test(new_data)
+        train = list(train_new) + past_data
         scratch = Path(tempfile.mkdtemp(prefix="oryx-candidates-"))
         try:
             best_path, best_eval = self._find_best_candidate_path(
@@ -157,15 +161,15 @@ class MLUpdate(BatchLayerUpdate):
         return best if best is not None else (None, None)
 
     # -- train/test split (splitTrainTest:342-376) ---------------------------
-    def split_new_data_to_train_test(self, all_data):
-        """Default random split by test-fraction; subclasses may override with
-        e.g. time-ordered splits (ALSUpdate.java:326-343)."""
+    def split_new_data_to_train_test(self, new_data):
+        """Default random split of the NEW data by test-fraction; subclasses
+        may override with e.g. time-ordered splits (ALSUpdate.java:326-343)."""
         if self.test_fraction <= 0:
-            return all_data, []
+            return new_data, []
         rng = rand.get_random()
-        mask = rng.random(len(all_data)) < self.test_fraction
-        train = [d for d, m in zip(all_data, mask) if not m]
-        test = [d for d, m in zip(all_data, mask) if m]
+        mask = rng.random(len(new_data)) < self.test_fraction
+        train = [d for d, m in zip(new_data, mask) if not m]
+        test = [d for d, m in zip(new_data, mask) if m]
         return train, test
 
 

@@ -1,0 +1,181 @@
+"""ALS input preparation: parse → decay → aggregate → index.
+
+Host-side equivalent of the reference's ALSUpdate input pipeline
+(app/oryx-app-mllib/.../als/ALSUpdate.java:326-423): CSV/JSON lines
+``user,item,strength[,timestamp]`` with empty strength = delete (NaN);
+time-decay of ratings (decayRating:383-389, ``oryx.als.decay.*``);
+aggregation — implicit: NaN-aware sum per (user,item) (delete wins the pair),
+explicit: last-by-timestamp wins (aggregateScores:395-423); optional
+``log1p(v/epsilon)`` strength scaling; and string-ID → dense-index maps
+(buildIDIndexMapping:181-190) built with host dictionaries instead of a
+Spark zipWithIndex shuffle.
+
+Output is a COO batch of (row, col, value) numpy arrays sorted by row,
+ready to ship to the device trainer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from oryx_tpu.common import textutils
+
+
+@dataclass
+class Interaction:
+    user: str
+    item: str
+    value: float  # NaN = delete
+    timestamp_ms: int
+
+
+def parse_line(line: str, now_ms: int | None = None) -> Interaction:
+    """user,item[,strength[,ts]]; empty strength = delete → NaN."""
+    tokens = textutils.parse_possibly_json(line)
+    if len(tokens) < 2:
+        raise ValueError(f"bad ALS input: {line!r}")
+    user, item = tokens[0], tokens[1]
+    if len(tokens) >= 3:
+        value = float("nan") if tokens[2] == "" else float(tokens[2])
+    else:
+        value = 1.0
+    ts = int(float(tokens[3])) if len(tokens) >= 4 else (now_ms or int(time.time() * 1000))
+    return Interaction(user, item, value, ts)
+
+
+def parse_lines(lines: Iterable[str], now_ms: int | None = None) -> list[Interaction]:
+    out = []
+    for line in lines:
+        try:
+            out.append(parse_line(line, now_ms))
+        except (ValueError, IndexError):
+            import logging
+
+            logging.getLogger(__name__).warning("bad input: %s", line)
+    return out
+
+
+def decay(
+    interactions: Sequence[Interaction],
+    factor: float,
+    zero_threshold: float,
+    now_ms: int | None = None,
+) -> list[Interaction]:
+    """Exponential per-day decay + threshold filter (decayRating:383-389)."""
+    if factor >= 1.0 and zero_threshold <= 0.0:
+        return list(interactions)
+    now_ms = now_ms or int(time.time() * 1000)
+    out = []
+    for it in interactions:
+        v = it.value
+        if factor < 1.0 and it.timestamp_ms < now_ms and not np.isnan(v):
+            days = (now_ms - it.timestamp_ms) / 86400000.0
+            v = v * factor**days
+        if zero_threshold > 0.0 and not np.isnan(v) and v <= zero_threshold:
+            continue
+        out.append(Interaction(it.user, it.item, v, it.timestamp_ms))
+    return out
+
+
+def aggregate(
+    interactions: Sequence[Interaction],
+    implicit: bool,
+    log_strength: bool = False,
+    epsilon: float = 1.0e-5,
+) -> dict[tuple[str, str], float]:
+    """Combine per (user,item): implicit = NaN-aware sum (NaN anywhere deletes
+    the pair), explicit = last (by timestamp order) wins; then drop NaN and
+    apply optional log scaling (aggregateScores:395-423)."""
+    ordered = sorted(interactions, key=lambda it: it.timestamp_ms)
+    agg: dict[tuple[str, str], float] = {}
+    if implicit:
+        for it in ordered:
+            k = (it.user, it.item)
+            if np.isnan(it.value):
+                agg[k] = float("nan")
+            else:
+                cur = agg.get(k)
+                if cur is None:
+                    agg[k] = it.value
+                elif not np.isnan(cur):
+                    agg[k] = cur + it.value
+                # cur NaN: delete sticks for this batch (SUM_WITH_NAN)
+    else:
+        for it in ordered:
+            agg[(it.user, it.item)] = it.value
+    result = {k: v for k, v in agg.items() if not np.isnan(v)}
+    if log_strength:
+        result = {k: float(np.log1p(v / epsilon)) for k, v in result.items()}
+    return result
+
+
+class IDIndexMapping:
+    """Bidirectional string-ID ↔ dense-index maps for one axis
+    (buildIDIndexMapping:181-190; sorted for determinism)."""
+
+    def __init__(self, ids: Iterable[str]):
+        self.index_to_id: list[str] = sorted(set(ids))
+        self.id_to_index: dict[str, int] = {s: i for i, s in enumerate(self.index_to_id)}
+
+    def __len__(self) -> int:
+        return len(self.index_to_id)
+
+
+@dataclass
+class RatingBatch:
+    """COO ratings sorted by row, plus the ID maps."""
+
+    rows: np.ndarray  # int32 [nnz]
+    cols: np.ndarray  # int32 [nnz]
+    vals: np.ndarray  # float32 [nnz]
+    users: IDIndexMapping
+    items: IDIndexMapping
+
+    @property
+    def nnz(self) -> int:
+        return len(self.vals)
+
+
+def build_rating_batch(
+    aggregated: dict[tuple[str, str], float],
+    users: IDIndexMapping | None = None,
+    items: IDIndexMapping | None = None,
+) -> RatingBatch:
+    if users is None:
+        users = IDIndexMapping(u for (u, _i) in aggregated)
+    if items is None:
+        items = IDIndexMapping(i for (_u, i) in aggregated)
+    rows = np.empty(len(aggregated), dtype=np.int32)
+    cols = np.empty(len(aggregated), dtype=np.int32)
+    vals = np.empty(len(aggregated), dtype=np.float32)
+    n = 0
+    for (u, i), v in aggregated.items():
+        ui = users.id_to_index.get(u)
+        ii = items.id_to_index.get(i)
+        if ui is None or ii is None:
+            continue
+        rows[n], cols[n], vals[n] = ui, ii, v
+        n += 1
+    rows, cols, vals = rows[:n], cols[:n], vals[:n]
+    order = np.argsort(rows, kind="stable")
+    return RatingBatch(rows[order], cols[order], vals[order], users, items)
+
+
+def prepare(
+    lines: Iterable[str],
+    implicit: bool,
+    decay_factor: float = 1.0,
+    decay_zero_threshold: float = 0.0,
+    log_strength: bool = False,
+    epsilon: float = 1.0e-5,
+    now_ms: int | None = None,
+) -> RatingBatch:
+    """Full pipeline: parse → decay → aggregate → index → COO."""
+    interactions = parse_lines(lines, now_ms)
+    interactions = decay(interactions, decay_factor, decay_zero_threshold, now_ms)
+    agg = aggregate(interactions, implicit, log_strength, epsilon)
+    return build_rating_batch(agg)

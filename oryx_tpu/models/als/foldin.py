@@ -1,0 +1,59 @@
+"""ALS incremental fold-in: the speed/serving update kernel.
+
+Equivalent of the reference's ALSUtils (app/oryx-app-common/.../als/
+ALSUtils.java:37-106): given a new interaction (u, i, value), compute the
+target estimated strength Qui' (implicit: interpolate between current estimate
+and 1/0 by strength; explicit: the new value), then the factor delta
+dXu = solve(YtY, dQui·Yi) and Xu += dXu. The same math updates item vectors
+from user vectors.
+
+Two forms: a scalar host form (mirror of the reference, used per-interaction
+by managers) and a jit'd batched form used to fold a whole microbatch of
+interactions in one device call (sorted fold order preserved by lax.scan —
+sequential dependence between repeated users is honored like the reference's
+in-order stream).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from oryx_tpu.ops.solver import Solver
+
+
+def compute_target_qui(implicit: bool, value: float, current_value: float) -> float:
+    """Target estimated strength, or NaN for 'no change'
+    (ALSUtils.computeTargetQui:37-59)."""
+    if implicit:
+        if value > 0.0 and current_value < 1.0:
+            diff = 1.0 - max(0.0, current_value)
+            return current_value + (value / (1.0 + value)) * diff
+        if value < 0.0 and current_value > 0.0:
+            diff = -min(1.0, current_value)
+            return current_value + (value / (value - 1.0)) * diff
+        return float("nan")
+    return value
+
+
+def compute_updated_xu(
+    solver: Solver,
+    value: float,
+    xu: "np.ndarray | None",
+    yi: "np.ndarray | None",
+    implicit: bool,
+) -> "np.ndarray | None":
+    """New user vector, or None for no change (ALSUtils.computeUpdatedXu:75-106)."""
+    if yi is None:
+        return None
+    no_xu = xu is None
+    qui = 0.0 if no_xu else float(np.dot(xu, yi))
+    # 0.5 reflects a "don't know" state
+    target_qui = compute_target_qui(implicit, value, 0.5 if no_xu else qui)
+    if math.isnan(target_qui):
+        return None
+    d_qui = target_qui - qui
+    dxu = solver.solve_d_to_d(np.asarray(yi, dtype=np.float64) * d_qui)
+    base = np.zeros(len(dxu), dtype=np.float32) if no_xu else np.asarray(xu, dtype=np.float32).copy()
+    return base + dxu.astype(np.float32)

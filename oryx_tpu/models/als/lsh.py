@@ -1,0 +1,102 @@
+"""Locality-sensitive hashing for approximate top-N (sample-rate semantics).
+
+Equivalent of the reference's LocalitySensitiveHash
+(app/oryx-app-serving/.../als/model/LocalitySensitiveHash.java:41-177):
+``oryx.als.sample-rate`` < 1 trades recall for speed by only scoring items
+whose sign-bit hash (under near-orthogonal random hyperplanes) lies within
+``max_bits_differing`` of the query's hash. Hash count and allowed bit
+difference are chosen so the candidate-bucket fraction approximates the
+sample rate.
+
+TPU re-design: the reference scans candidate *partitions* with a thread pool;
+here items carry a bucket id, and top-N masks non-candidate rows to −∞ inside
+the same single matmul+top_k device program — the knob preserves the
+reference's approximation semantics, while TPU speed comes from the batched
+matmul itself (serving.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from oryx_tpu.common import rand
+
+MAX_HASHES = 16
+
+
+def _candidate_fraction(n_hashes: int, max_bits_differing: int) -> float:
+    total = sum(math.comb(n_hashes, d) for d in range(max_bits_differing + 1))
+    return total / (1 << n_hashes)
+
+
+def choose_hash_config(sample_rate: float) -> tuple[int, int]:
+    """Smallest hash count + allowed differing bits whose candidate fraction
+    is closest to (without exceeding much) the sample rate
+    (LocalitySensitiveHash.java:41-74)."""
+    if sample_rate >= 1.0:
+        return 0, 0
+    best = (1, 0)
+    best_err = float("inf")
+    for n in range(1, MAX_HASHES + 1):
+        for d in range(n):
+            frac = _candidate_fraction(n, d)
+            if frac <= sample_rate:
+                err = sample_rate - frac
+                if err < best_err:
+                    best_err = err
+                    best = (n, d)
+    return best
+
+
+class LocalitySensitiveHash:
+    def __init__(self, sample_rate: float, features: int):
+        self.sample_rate = sample_rate
+        self.features = features
+        self.num_hashes, self.max_bits_differing = choose_hash_config(sample_rate)
+        rng = rand.get_random()
+        if self.num_hashes:
+            # near-orthogonal random hyperplanes (:80-105)
+            m = rng.standard_normal((self.num_hashes, features)).astype(np.float32)
+            q, _ = np.linalg.qr(m.T) if features >= self.num_hashes else (m.T, None)
+            self.hyperplanes = np.ascontiguousarray(q.T[: self.num_hashes], dtype=np.float32)
+        else:
+            self.hyperplanes = np.zeros((0, features), dtype=np.float32)
+
+    @property
+    def num_buckets(self) -> int:
+        return 1 << self.num_hashes
+
+    def get_index_for(self, vector: np.ndarray) -> int:
+        """Sign-bit hash (:142)."""
+        if not self.num_hashes:
+            return 0
+        bits = (self.hyperplanes @ np.asarray(vector, dtype=np.float32)) > 0
+        idx = 0
+        for b in bits:
+            idx = (idx << 1) | int(b)
+        return idx
+
+    def assign_buckets(self, matrix: np.ndarray) -> np.ndarray:
+        """Bucket id per row, vectorized."""
+        if not self.num_hashes:
+            return np.zeros(len(matrix), dtype=np.int32)
+        bits = (matrix @ self.hyperplanes.T) > 0  # (n, h)
+        weights = (1 << np.arange(self.num_hashes - 1, -1, -1)).astype(np.int32)
+        return (bits.astype(np.int32) @ weights).astype(np.int32)
+
+    def get_candidate_indices(self, vector: np.ndarray) -> np.ndarray:
+        """All bucket ids within max_bits_differing of the query hash (:156-177)."""
+        base = self.get_index_for(vector)
+        if not self.num_hashes:
+            return np.asarray([0], dtype=np.int32)
+        n = self.num_buckets
+        all_ids = np.arange(n, dtype=np.int32)
+        xor = all_ids ^ base
+        popcount = np.zeros(n, dtype=np.int32)
+        v = xor.copy()
+        while v.any():
+            popcount += v & 1
+            v >>= 1
+        return all_ids[popcount <= self.max_bits_differing]

@@ -1,0 +1,297 @@
+"""ALS serving model: device-resident factors answering recommendation queries.
+
+Equivalent of the reference's ALSServingModel / ALSServingModelManager /
+TopNConsumer (app/oryx-app-serving/.../als/model/ALSServingModel.java:61-418,
+ALSServingModelManager.java:44-182, TopNConsumer.java:30-80).
+
+TPU re-design of the query path: the reference fans a top-N scan over
+LSH-partitioned hash maps with a thread pool; here Y materializes into one
+dense device matrix (dirty-flag cache), and top-N is a single
+``scores = Y @ q`` matmul + ``lax.top_k`` on the MXU — with optional LSH
+masking preserving ``sample-rate`` approximation semantics, and item norms
+cached for cosine queries. Point updates (UP messages) mutate host maps and
+only re-materialize lazily, so the query path never blocks on updates
+(the double-buffer answer to JAX array immutability).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import math
+import threading
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.api.serving import ServingModel
+from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
+from oryx_tpu.api.serving import AbstractServingModelManager
+from oryx_tpu.models.als import pmml_codec
+from oryx_tpu.models.als.lsh import LocalitySensitiveHash
+from oryx_tpu.models.als.rescorer import load_rescorer_providers
+from oryx_tpu.models.als.vectors import FeatureVectorStore
+from oryx_tpu.ops.solver import SolverCache
+
+log = logging.getLogger(__name__)
+
+
+def _round_up_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _top_k_dot(mat, q, valid, k: int):
+    scores = mat @ q
+    scores = jnp.where(valid, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
+    # mean cosine similarity to several query vectors (CosineAverageFunction.java)
+    sims = (mat @ qs.T) / jnp.maximum(norms[:, None] * q_norms[None, :], 1e-12)
+    scores = jnp.where(valid, jnp.mean(sims, axis=1), -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class _YSnapshot:
+    """Immutable device view of Y: ids, matrix, norms, LSH buckets."""
+
+    def __init__(self, ids: list[str], mat, lsh: LocalitySensitiveHash | None):
+        self.ids = ids
+        self.mat = mat  # jax (n, k) or None
+        self.id_to_idx = {s: i for i, s in enumerate(ids)}
+        if mat is not None:
+            self.norms = jnp.linalg.norm(mat, axis=1)
+            host = np.asarray(mat)
+            self.buckets = (
+                jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
+            )
+        else:
+            self.norms = None
+            self.buckets = None
+
+    @property
+    def n(self) -> int:
+        return len(self.ids)
+
+
+class ALSServingModel(ServingModel):
+    def __init__(self, features: int, implicit: bool, sample_rate: float = 1.0):
+        self.features = features
+        self.implicit = implicit
+        self.sample_rate = sample_rate
+        self.x = FeatureVectorStore()
+        self.y = FeatureVectorStore()
+        self.lsh = LocalitySensitiveHash(sample_rate, features) if sample_rate < 1.0 else None
+        self.known_items: dict[str, set[str]] = {}
+        self._known_lock = threading.Lock()
+        self.expected_user_ids: set[str] = set()
+        self.expected_item_ids: set[str] = set()
+        self.yty_cache = SolverCache(self.y.get_vtv)
+        self._snapshot: _YSnapshot | None = None
+        self._snapshot_src = None
+        self._snap_lock = threading.Lock()
+
+    # -- vector + known-item bookkeeping ------------------------------------
+    def set_user_vector(self, user: str, vec) -> None:
+        self.x.set_vector(user, vec)
+        self.expected_user_ids.discard(user)
+
+    def set_item_vector(self, item: str, vec) -> None:
+        self.y.set_vector(item, vec)
+        self.expected_item_ids.discard(item)
+        self.yty_cache.set_dirty()
+
+    def get_user_vector(self, user: str):
+        return self.x.get_vector(user)
+
+    def get_item_vector(self, item: str):
+        return self.y.get_vector(item)
+
+    def add_known_items(self, user: str, items: Sequence[str]) -> None:
+        with self._known_lock:
+            self.known_items.setdefault(user, set()).update(items)
+
+    def get_known_items(self, user: str) -> set[str]:
+        with self._known_lock:
+            return set(self.known_items.get(user, ()))
+
+    def all_user_ids(self) -> list[str]:
+        return self.x.ids()
+
+    def all_item_ids(self) -> list[str]:
+        return self.y.ids()
+
+    def retain_recent_and_user_ids(self, ids) -> None:
+        self.x.retain_recent_and_ids(set(ids))
+
+    def retain_recent_and_item_ids(self, ids) -> None:
+        self.y.retain_recent_and_ids(set(ids))
+        self.yty_cache.set_dirty()
+
+    def retain_recent_and_known_items(self, users) -> None:
+        keep = set(users)
+        with self._known_lock:
+            for u in list(self.known_items):
+                if u not in keep:
+                    del self.known_items[u]
+
+    def get_fraction_loaded(self) -> float:  # ALSServingModel.java:396
+        total = len(self.expected_user_ids) + len(self.expected_item_ids)
+        total += self.x.size() + self.y.size()
+        if total == 0:
+            return 1.0
+        return (self.x.size() + self.y.size()) / total
+
+    # -- device snapshot ----------------------------------------------------
+    def y_snapshot(self) -> _YSnapshot:
+        ids, mat = self.y.materialize()
+        with self._snap_lock:
+            if self._snapshot is None or self._snapshot_src is not mat:
+                self._snapshot = _YSnapshot(ids, mat, self.lsh)
+                self._snapshot_src = mat
+            return self._snapshot
+
+    # -- query primitives ----------------------------------------------------
+    def top_n(
+        self,
+        query_vec: np.ndarray,
+        how_many: int,
+        offset: int = 0,
+        allowed: "Callable[[str], bool] | None" = None,
+        rescore: "Callable[[str, float], float] | None" = None,
+    ) -> list[tuple[str, float]]:
+        """Dot-product top-N over Y: one matmul + top_k (ALSServingModel.topN
+        :261-276, TopNConsumer:56-73), then host-side filter/rescore/merge."""
+        snap = self.y_snapshot()
+        if snap.mat is None or snap.n == 0:
+            return []
+        q = jnp.asarray(np.asarray(query_vec, dtype=np.float32))
+        valid = self._candidate_mask(snap, np.asarray(query_vec, dtype=np.float32))
+        want = how_many + offset
+        k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
+        while True:
+            vals, idx = _top_k_dot(snap.mat, q, valid, k)
+            out = self._collect(snap, np.asarray(vals), np.asarray(idx), want, allowed, rescore)
+            if len(out) >= want or k >= snap.n:
+                return out[offset:offset + how_many]
+            k = min(snap.n, k * 2)  # widen if filtering consumed candidates
+
+    def top_n_cosine(
+        self,
+        query_vecs: np.ndarray,
+        how_many: int,
+        offset: int = 0,
+        allowed: "Callable[[str], bool] | None" = None,
+        rescore: "Callable[[str, float], float] | None" = None,
+    ) -> list[tuple[str, float]]:
+        """Mean-cosine top-N for /similarity (CosineAverageFunction.java:67)."""
+        snap = self.y_snapshot()
+        if snap.mat is None or snap.n == 0:
+            return []
+        qs_host = np.atleast_2d(np.asarray(query_vecs, dtype=np.float32))
+        qs = jnp.asarray(qs_host)
+        q_norms = jnp.linalg.norm(qs, axis=1)
+        # union of candidate buckets across ALL query vectors, mirroring the
+        # reference's per-partition candidate scan
+        valid = self._candidate_mask(snap, qs_host[0])
+        for extra in qs_host[1:]:
+            valid = valid | self._candidate_mask(snap, extra)
+        want = how_many + offset
+        k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
+        while True:
+            vals, idx = _top_k_cosine_sum(snap.mat, snap.norms, qs, q_norms, valid, k)
+            out = self._collect(snap, np.asarray(vals), np.asarray(idx), want, allowed, rescore)
+            if len(out) >= want or k >= snap.n:
+                return out[offset:offset + how_many]
+            k = min(snap.n, k * 2)
+
+    def _candidate_mask(self, snap: _YSnapshot, query_vec: np.ndarray):
+        if self.lsh is None or snap.buckets is None:
+            return jnp.ones(snap.n, dtype=bool)
+        candidates = self.lsh.get_candidate_indices(query_vec)
+        lut = np.zeros(self.lsh.num_buckets, dtype=bool)
+        lut[candidates] = True
+        return jnp.asarray(lut)[snap.buckets]
+
+    @staticmethod
+    def _collect(snap, vals, idx, want, allowed, rescore) -> list[tuple[str, float]]:
+        out: list[tuple[str, float]] = []
+        for v, i in zip(vals, idx):
+            if not np.isfinite(v):
+                break
+            id_ = snap.ids[int(i)]
+            if allowed is not None and not allowed(id_):
+                continue
+            score = float(v)
+            if rescore is not None:
+                score = rescore(id_, score)
+                if math.isnan(score):
+                    continue
+            out.append((id_, score))
+        if rescore is not None:
+            out.sort(key=lambda t: -t[1])
+        return out
+
+    def dot_with_items(self, query_vec: np.ndarray, item_ids: Sequence[str]) -> list[float]:
+        q = np.asarray(query_vec, dtype=np.float32)
+        return [
+            float(np.dot(q, v)) if (v := self.y.get_vector(i)) is not None else 0.0
+            for i in item_ids
+        ]
+
+    def get_yty_solver(self):
+        return self.yty_cache.get(blocking=True)
+
+    def precompute_solvers(self) -> None:
+        self.yty_cache.compute_now()
+
+
+class ALSServingModelManager(AbstractServingModelManager):
+    def __init__(self, config):
+        super().__init__(config)
+        self.sample_rate = config.get_float("oryx.als.sample-rate")
+        self.min_model_load_fraction = config.get_float("oryx.serving.min-model-load-fraction")
+        self.model: ALSServingModel | None = None
+        self.rescorer_provider = load_rescorer_providers(config)
+
+    def get_model(self) -> "ALSServingModel | None":
+        return self.model
+
+    def consume_key_message(self, key: str, message: str) -> None:
+        if key == "UP":
+            if self.model is None:
+                return
+            update = json.loads(message)
+            kind, id_, vec = update[0], update[1], np.asarray(update[2], dtype=np.float32)
+            if kind == "X":
+                self.model.set_user_vector(id_, vec)
+                if len(update) > 3:
+                    self.model.add_known_items(id_, update[3])
+            elif kind == "Y":
+                self.model.set_item_vector(id_, vec)
+            else:
+                raise ValueError(f"bad update type: {kind}")
+        elif key in ("MODEL", "MODEL-REF"):
+            pmml = read_pmml_from_update_key_message(key, message)
+            meta = pmml_codec.pmml_to_meta(pmml)
+            features = meta["features"]
+            if self.model is None or self.model.features != features:
+                log.info("new serving model (features=%d)", features)
+                self.model = ALSServingModel(features, meta["implicit"], self.sample_rate)
+                self.model.expected_user_ids = set(meta["x_ids"])
+                self.model.expected_item_ids = set(meta["y_ids"])
+            else:
+                m = self.model
+                m.retain_recent_and_user_ids(meta["x_ids"])
+                m.retain_recent_and_item_ids(meta["y_ids"])
+                m.retain_recent_and_known_items(meta["x_ids"])
+                m.expected_user_ids = set(meta["x_ids"]) - set(m.x.ids())
+                m.expected_item_ids = set(meta["y_ids"]) - set(m.y.ids())
+        else:
+            raise ValueError(f"bad key: {key}")

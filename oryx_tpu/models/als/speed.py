@@ -1,0 +1,154 @@
+"""ALS speed tier: in-memory model + per-microbatch fold-in updates.
+
+Equivalent of the reference's ALSSpeedModel / ALSSpeedModelManager
+(app/oryx-app/.../als/ALSSpeedModel.java:39-183,
+ALSSpeedModelManager.java:51-233):
+
+  * the model holds X and Y vector stores, expected-ID sets driving
+    ``get_fraction_loaded``, and two single-flight SolverCaches (XᵀX, YᵀY);
+  * ``MODEL``/``MODEL-REF`` messages start a new/retained model when the
+    feature count changes, and set expectations + GC via retain-and-expect;
+  * ``UP`` messages apply X/Y vectors (its own and the batch layer's);
+  * ``build_updates`` gates on min-model-load-fraction, pre-warms solvers,
+    sorts the microbatch by timestamp, aggregates with NaN-delete semantics,
+    then folds in each interaction via the closed-form delta solve
+    (foldin.compute_updated_xu) for both Xu and Yi, emitting
+    ``["X", user, vec]`` / ``["Y", item, vec]`` JSON updates.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+from oryx_tpu.api.speed import AbstractSpeedModelManager, SpeedModel
+from oryx_tpu.common.lockutils import RateLimitCheck
+from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
+from oryx_tpu.models.als import data as als_data
+from oryx_tpu.models.als import foldin
+from oryx_tpu.models.als import pmml_codec
+from oryx_tpu.models.als.vectors import FeatureVectorStore
+from oryx_tpu.ops.solver import SolverCache
+
+log = logging.getLogger(__name__)
+
+
+class ALSSpeedModel(SpeedModel):
+    """X/Y stores + expected IDs + solver caches (ALSSpeedModel.java:39-183)."""
+
+    def __init__(self, features: int, implicit: bool):
+        self.features = features
+        self.implicit = implicit
+        self.x = FeatureVectorStore()
+        self.y = FeatureVectorStore()
+        self.expected_user_ids: set[str] = set()
+        self.expected_item_ids: set[str] = set()
+        self.xtx_cache = SolverCache(self.x.get_vtv)
+        self.yty_cache = SolverCache(self.y.get_vtv)
+
+    def set_user_vector(self, user: str, vec: np.ndarray) -> None:
+        self.x.set_vector(user, vec)
+        self.expected_user_ids.discard(user)
+        self.xtx_cache.set_dirty()
+
+    def set_item_vector(self, item: str, vec: np.ndarray) -> None:
+        self.y.set_vector(item, vec)
+        self.expected_item_ids.discard(item)
+        self.yty_cache.set_dirty()
+
+    def retain_recent_and_user_ids(self, ids) -> None:
+        self.x.retain_recent_and_ids(set(ids))
+        self.xtx_cache.set_dirty()
+
+    def retain_recent_and_item_ids(self, ids) -> None:
+        self.y.retain_recent_and_ids(set(ids))
+        self.yty_cache.set_dirty()
+
+    def get_fraction_loaded(self) -> float:  # ALSSpeedModel.java:158-171
+        total = self.x.size() + self.y.size() + len(self.expected_user_ids) + len(
+            self.expected_item_ids
+        )
+        if total == 0:
+            return 1.0
+        return (self.x.size() + self.y.size()) / total
+
+
+class ALSSpeedModelManager(AbstractSpeedModelManager):
+    def __init__(self, config):
+        self.config = config
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.log_strength = config.get_bool("oryx.als.logStrength")
+        self.epsilon = config.get_float("oryx.als.hyperparams.epsilon")
+        self.min_model_load_fraction = config.get_float("oryx.speed.min-model-load-fraction")
+        self.model: ALSSpeedModel | None = None
+        self._log_rate = RateLimitCheck(60)
+
+    # -- update-topic consumption (consumeKeyMessage:67-133) -----------------
+    def consume_key_message(self, key: str, message: str) -> None:
+        if key == "UP":
+            if self.model is None:
+                return  # ignore updates before the first model
+            update = json.loads(message)
+            kind, id_, vec = update[0], update[1], np.asarray(update[2], dtype=np.float32)
+            if kind == "X":
+                self.model.set_user_vector(id_, vec)
+            elif kind == "Y":
+                self.model.set_item_vector(id_, vec)
+            else:
+                raise ValueError(f"bad update type: {kind}")
+        elif key in ("MODEL", "MODEL-REF"):
+            pmml = read_pmml_from_update_key_message(key, message)
+            meta = pmml_codec.pmml_to_meta(pmml)
+            features = meta["features"]
+            if self.model is None or self.model.features != features:
+                log.info("new model (features=%d)", features)
+                self.model = ALSSpeedModel(features, meta["implicit"])
+                self.model.expected_user_ids = set(meta["x_ids"])
+                self.model.expected_item_ids = set(meta["y_ids"])
+            else:
+                self.model.retain_recent_and_user_ids(meta["x_ids"])
+                self.model.retain_recent_and_item_ids(meta["y_ids"])
+                self.model.expected_user_ids = set(meta["x_ids"]) - set(self.model.x.ids())
+                self.model.expected_item_ids = set(meta["y_ids"]) - set(self.model.y.ids())
+        else:
+            raise ValueError(f"bad key: {key}")
+
+    # -- microbatch fold-in (buildUpdates:135-221) ---------------------------
+    def build_updates(self, new_data):
+        model = self.model
+        if model is None:
+            return []
+        fraction = model.get_fraction_loaded()
+        if fraction < self.min_model_load_fraction:
+            if self._log_rate.test():
+                log.info("model not yet loaded enough (%.3f)", fraction)
+            return []
+        # pre-warm both solvers (precomputeSolvers :142)
+        model.xtx_cache.compute_now()
+        model.yty_cache.compute_now()
+
+        interactions = als_data.parse_lines([km.message for km in new_data])
+        # aggregate() sorts by timestamp internally (data.py)
+        agg = als_data.aggregate(
+            interactions, self.implicit, self.log_strength, self.epsilon
+        )
+        if not agg:
+            return []
+        yty_solver = model.yty_cache.get(blocking=True)
+        xtx_solver = model.xtx_cache.get(blocking=True)
+        updates: list[str] = []
+        for (user, item), value in agg.items():
+            xu = model.x.get_vector(user)
+            yi = model.y.get_vector(item)
+            if yty_solver is not None:
+                new_xu = foldin.compute_updated_xu(yty_solver, value, xu, yi, self.implicit)
+                if new_xu is not None:
+                    updates.append(json.dumps(["X", user, [float(v) for v in new_xu]]))
+            # symmetric item update (ALSSpeedModelManager.java:209-219)
+            if xtx_solver is not None:
+                new_yi = foldin.compute_updated_xu(xtx_solver, value, yi, xu, self.implicit)
+                if new_yi is not None:
+                    updates.append(json.dumps(["Y", item, [float(v) for v in new_yi]]))
+        return updates

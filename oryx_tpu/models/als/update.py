@@ -1,0 +1,183 @@
+"""ALS batch update: the MLUpdate implementation for collaborative filtering.
+
+Equivalent of the reference's ALSUpdate (app/oryx-app-mllib/.../als/
+ALSUpdate.java:82-343): hyperparameters from ``oryx.als.hyperparams.*``
+(features, lambda, alpha, and epsilon iff logStrength), time-decayed and
+NaN-aware-aggregated input, TPU ALS training (train.als_train), evaluation
+(implicit: mean AUC; explicit: −RMSE), time-ordered train/test split
+(splitNewDataToTrainTest:326-343), pointer-PMML artifact, and
+publish_additional_model_data streaming every Y then X row as
+``"UP" ["Y"/"X", id, vector(, knownItems)]`` (ALSUpdate.java:286-319 — items
+first so user endpoints return complete results once users arrive).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import rand
+from oryx_tpu.ml import param as hp
+from oryx_tpu.ml.mlupdate import MLUpdate
+from oryx_tpu.models.als import data as als_data
+from oryx_tpu.models.als import evaluate as als_eval
+from oryx_tpu.models.als import pmml_codec
+from oryx_tpu.models.als import train as als_train_mod
+
+log = logging.getLogger(__name__)
+
+
+class ALSUpdate(MLUpdate):
+    def __init__(self, config):
+        super().__init__(config)
+        self.iterations = config.get_int("oryx.als.iterations")
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.log_strength = config.get_bool("oryx.als.logStrength")
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.decay_factor = config.get_float("oryx.als.decay.factor")
+        self.decay_zero_threshold = config.get_float("oryx.als.decay.zero-threshold")
+        self.hyper_params = [
+            hp.from_config(config, "oryx.als.hyperparams.features"),
+            hp.from_config(config, "oryx.als.hyperparams.lambda"),
+            hp.from_config(config, "oryx.als.hyperparams.alpha"),
+        ]
+        if self.log_strength:
+            self.hyper_params.append(hp.from_config(config, "oryx.als.hyperparams.epsilon"))
+
+    def get_hyper_parameter_values(self):
+        return list(self.hyper_params)
+
+    # -- train (buildModel:108-179) -----------------------------------------
+    def build_model(self, context, train_data, hyper_parameters, candidate_path: Path):
+        features = int(hyper_parameters[0])
+        lam = float(hyper_parameters[1])
+        alpha = float(hyper_parameters[2])
+        epsilon = float(hyper_parameters[3]) if self.log_strength else 1.0e-5
+        assert features > 0 and lam >= 0.0 and alpha > 0.0
+
+        batch = als_data.prepare(
+            (km.message for km in train_data),
+            implicit=self.implicit,
+            decay_factor=self.decay_factor,
+            decay_zero_threshold=self.decay_zero_threshold,
+            log_strength=self.log_strength,
+            epsilon=epsilon,
+        )
+        if batch.nnz == 0 or len(batch.users) == 0 or len(batch.items) == 0:
+            return None
+        x, y = als_train_mod.als_train(
+            batch,
+            features=features,
+            lam=lam,
+            alpha=alpha,
+            implicit=self.implicit,
+            iterations=self.iterations,
+            key=rand.get_key(),
+        )
+        return pmml_codec.model_to_pmml(
+            np.asarray(x),
+            np.asarray(y),
+            batch.users.index_to_id,
+            batch.items.index_to_id,
+            features,
+            lam,
+            alpha,
+            self.implicit,
+            self.log_strength,
+            epsilon,
+            candidate_path,
+        )
+
+    # -- eval (evaluate:200-247) --------------------------------------------
+    def evaluate(self, context, model, model_parent_path: Path, test_data, train_data):
+        meta = pmml_codec.pmml_to_meta(model)
+        users = als_data.IDIndexMapping(meta["x_ids"])
+        items = als_data.IDIndexMapping(meta["y_ids"])
+        x = _load_matrix(Path(model_parent_path) / meta["x_dir"], users, meta["features"])
+        y = _load_matrix(Path(model_parent_path) / meta["y_dir"], items, meta["features"])
+        test_batch = als_data.build_rating_batch(
+            als_data.aggregate(
+                als_data.parse_lines([km.message for km in test_data]),
+                self.implicit,
+                meta["logStrength"],
+                meta["epsilon"],
+            ),
+            users,
+            items,
+        )
+        if self.implicit:
+            # rebuild the train known-set from the passed train data — stateless,
+            # safe under concurrent candidate evaluation
+            train_batch = als_data.build_rating_batch(
+                als_data.aggregate(
+                    als_data.parse_lines([km.message for km in train_data]),
+                    self.implicit,
+                    meta["logStrength"],
+                    meta["epsilon"],
+                ),
+                users,
+                items,
+            )
+            score = als_eval.area_under_curve(x, y, train_batch, test_batch)
+            log.info("AUC = %s", score)
+            return score
+        score = -als_eval.rmse(x, y, test_batch)
+        log.info("-RMSE = %s", score)
+        return score
+
+    # -- time-ordered split of NEW data (splitNewDataToTrainTest:326-343) ----
+    def split_new_data_to_train_test(self, new_data: Sequence[KeyMessage]):
+        if self.test_fraction <= 0:
+            return list(new_data), []
+
+        def ts(km: KeyMessage) -> int:
+            try:
+                return als_data.parse_line(km.message).timestamp_ms
+            except ValueError:
+                return 0
+
+        ordered = sorted(new_data, key=ts)
+        split = int(round(len(ordered) * (1.0 - self.test_fraction)))
+        return ordered[:split], ordered[split:]
+
+    # -- stream factors to serving/speed (publishAdditionalModelData:286-319) -
+    def publish_additional_model_data(self, context, pmml, new_data, past_data, model_path, producer):
+        meta = pmml_codec.pmml_to_meta(pmml)
+        y_path = Path(model_path) / meta["y_dir"]
+        x_path = Path(model_path) / meta["x_dir"]
+        # items first (reference comment: more complete /recommend once users load)
+        for id_, vec in pmml_codec.read_features(y_path):
+            producer.send("UP", json.dumps(["Y", id_, [float(v) for v in vec]]))
+        known_items: dict[str, list[str]] = {}
+        if not self.no_known_items:
+            known_sets: dict[str, set[str]] = {}
+            for km in list(new_data) + list(past_data):
+                try:
+                    it = als_data.parse_line(km.message)
+                except ValueError:
+                    continue
+                known_sets.setdefault(it.user, set()).add(it.item)
+            known_items = {u: sorted(s) for u, s in known_sets.items()}
+        for id_, vec in pmml_codec.read_features(x_path):
+            if known_items:
+                producer.send(
+                    "UP",
+                    json.dumps(["X", id_, [float(v) for v in vec], known_items.get(id_, [])]),
+                )
+            else:
+                producer.send("UP", json.dumps(["X", id_, [float(v) for v in vec]]))
+
+
+def _load_matrix(path: Path, mapping: als_data.IDIndexMapping, features: int) -> np.ndarray:
+    m = np.zeros((len(mapping), features), dtype=np.float32)
+    for id_, vec in pmml_codec.read_features(path):
+        idx = mapping.id_to_index.get(id_)
+        if idx is not None:
+            m[idx] = vec
+    return m

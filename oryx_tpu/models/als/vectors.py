@@ -1,0 +1,108 @@
+"""In-memory feature-vector store for speed/serving ALS models.
+
+Equivalent of the reference's FeatureVectors / FeatureVectorsPartition /
+PartitionedFeatureVectors (app/oryx-app-common/.../als/FeatureVectorsPartition.java:36-131,
+PartitionedFeatureVectors.java:43-93): id → float32 vector map plus a
+recent-ids set, guarded by one readers-writer lock, with ``retain_recent_and_ids``
+GC on model handoff.
+
+TPU re-design: where the reference partitions vectors across threads so
+serving scans parallelize on cores, here the whole store materializes into one
+dense device matrix (id order pinned) behind a dirty flag — scans become a
+single MXU matmul (models/als/serving.py), and per-id point updates only touch
+host state until the next materialization. get_vtv (the Gramian for fold-in
+solves) is one X.T @ X on device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from oryx_tpu.common.lockutils import AutoReadWriteLock
+
+
+class FeatureVectorStore:
+    def __init__(self):
+        self._vectors: dict[str, np.ndarray] = {}
+        self._recent_ids: set[str] = set()
+        self._lock = AutoReadWriteLock()
+        # device materialization cache, validated by a write-version counter
+        # (no dirty flag: a flag could be cleared over a concurrent write)
+        self._version = 0
+        self._cache_lock = threading.Lock()
+        self._cached_ids: list[str] | None = None
+        self._cached_matrix = None  # jax array
+        self._cached_version = -1
+
+    # -- map ops (FeatureVectorsPartition:55-108) ---------------------------
+    def set_vector(self, id_: str, vector: np.ndarray) -> None:
+        v = np.asarray(vector, dtype=np.float32)
+        with self._lock.write():
+            self._vectors[id_] = v
+            self._recent_ids.add(id_)
+            self._version += 1
+
+    def get_vector(self, id_: str) -> "np.ndarray | None":
+        with self._lock.read():
+            return self._vectors.get(id_)
+
+    def remove_vector(self, id_: str) -> None:
+        with self._lock.write():
+            self._vectors.pop(id_, None)
+            self._recent_ids.discard(id_)
+            self._version += 1
+
+    def size(self) -> int:
+        with self._lock.read():
+            return len(self._vectors)
+
+    def ids(self) -> list[str]:
+        with self._lock.read():
+            return list(self._vectors)
+
+    def retain_recent_and_ids(self, ids: "set[str]") -> None:
+        """GC on new-model handoff: drop vectors neither recently updated nor
+        in the new model (FeatureVectorsPartition.retainRecentAndIDs)."""
+        with self._lock.write():
+            keep = self._recent_ids | set(ids)
+            for k in list(self._vectors):
+                if k not in keep:
+                    del self._vectors[k]
+            self._recent_ids.clear()
+            self._version += 1
+
+    # -- device materialization --------------------------------------------
+    def materialize(self):
+        """(ids, device matrix) snapshot; rebuilt only when writes happened
+        since the cached version (race-free: the version is read under the
+        same read lock as the snapshot, so a concurrent write strictly
+        invalidates this materialization)."""
+        import jax.numpy as jnp
+
+        with self._lock.read():
+            version = self._version
+            with self._cache_lock:
+                if self._cached_version == version:
+                    return self._cached_ids, self._cached_matrix
+            ids = list(self._vectors)
+            mat = (
+                np.stack([self._vectors[i] for i in ids])
+                if ids
+                else np.zeros((0, 0), dtype=np.float32)
+            )
+        device_mat = jnp.asarray(mat) if mat.size else None
+        with self._cache_lock:
+            if version > self._cached_version:
+                self._cached_ids = ids
+                self._cached_matrix = device_mat
+                self._cached_version = version
+            return self._cached_ids, self._cached_matrix
+
+    def get_vtv(self):
+        """Gramian V^T V on device (FeatureVectors.getVTV)."""
+        _, mat = self.materialize()
+        if mat is None:
+            return None
+        return np.asarray(mat.T @ mat)

@@ -31,6 +31,23 @@ _DATA_SEGMENT_RE = re.compile(r"oryx-(\d+)\.data")
 _MODEL_DIR_RE = re.compile(r"(\d+)")
 
 
+def _delete_older_than(
+    dirs, timestamp_of, max_age_hours: int, now_ms: "int | None"
+) -> list[Path]:
+    """Shared TTL-GC policy (DeleteOldDataFn.java); max_age_hours < 0 disables."""
+    if max_age_hours < 0:
+        return []
+    now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+    cutoff = now_ms - max_age_hours * 3600 * 1000
+    deleted = []
+    for d in dirs:
+        ts = timestamp_of(d)
+        if ts is not None and ts < cutoff:
+            ioutils.delete_recursively(d)
+            deleted.append(d)
+    return deleted
+
+
 class DataStore:
     """Append/read/GC of timestamped data segments under one data-dir."""
 
@@ -71,18 +88,11 @@ class DataStore:
         return sorted(self._dir.glob("oryx-*.data")) if self._dir.exists() else []
 
     def delete_older_than(self, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
-        """TTL GC (DeleteOldDataFn.java); max_age_hours < 0 disables."""
-        if max_age_hours < 0:
-            return []
-        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
-        cutoff = now_ms - max_age_hours * 3600 * 1000
-        deleted = []
-        for seg in self.segments():
+        def ts_of(seg: Path):
             m = _DATA_SEGMENT_RE.fullmatch(seg.name)
-            if m and int(m.group(1)) < cutoff:
-                ioutils.delete_recursively(seg)
-                deleted.append(seg)
-        return deleted
+            return int(m.group(1)) if m else None
+
+        return _delete_older_than(self.segments(), ts_of, max_age_hours, now_ms)
 
 
 class ModelStore:
@@ -124,16 +134,9 @@ class ModelStore:
         return dirs[-1] if dirs else None
 
     def delete_older_than(self, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
-        if max_age_hours < 0:
-            return []
-        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
-        cutoff = now_ms - max_age_hours * 3600 * 1000
-        deleted = []
-        for d in self.model_dirs():
-            if int(d.name) < cutoff:
-                ioutils.delete_recursively(d)
-                deleted.append(d)
-        return deleted
+        return _delete_older_than(
+            self.model_dirs(), lambda d: int(d.name), max_age_hours, now_ms
+        )
 
 
 def _strip_scheme(path: str) -> str:

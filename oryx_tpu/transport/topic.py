@@ -250,10 +250,14 @@ class FileBroker(Broker):
         p = self._log_path(topic)
         if not p.exists():
             raise TopicException(f"topic does not exist: {topic}")
-        line = json.dumps({"k": key, "m": message}, separators=(",", ":")) + "\n"
+        data = (json.dumps({"k": key, "m": message}, separators=(",", ":")) + "\n").encode("utf-8")
         fd = os.open(p, os.O_WRONLY | os.O_APPEND)
         try:
-            os.write(fd, line.encode("utf-8"))
+            written = os.write(fd, data)
+            # loop on short writes; only the first write is append-atomic, but
+            # a torn tail is better than a silently dropped one
+            while written < len(data):
+                written += os.write(fd, data[written:])
         finally:
             os.close(fd)
 
@@ -291,8 +295,13 @@ class FileBroker(Broker):
         with open(p, "rb") as f:
             f.seek(idx[offset])
             blob = f.read(idx[end] - idx[offset])
-        for raw in blob.split(b"\n"):
+        lines = blob.split(b"\n")
+        if lines and not lines[-1]:
+            lines.pop()  # trailing newline artifact only; blank interior
+            # lines must still produce CORRUPT_RECORD to keep offsets aligned
+        for raw in lines:
             if not raw.strip():
+                out.append(CORRUPT_RECORD)
                 continue
             try:
                 d = json.loads(raw)

@@ -1,0 +1,297 @@
+"""ALS vertical tests (mirrors reference ALSUtilsTest, ALSUpdateIT,
+ALSSpeedIT, ALSServingModelTest, LocalitySensitiveHashTest — SURVEY §4)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.models.als import data as d
+from oryx_tpu.models.als import evaluate as ev
+from oryx_tpu.models.als import foldin, pmml_codec
+from oryx_tpu.models.als import train as tr
+from oryx_tpu.models.als.lsh import LocalitySensitiveHash, choose_hash_config
+from oryx_tpu.models.als.serving import ALSServingModel, ALSServingModelManager
+from oryx_tpu.models.als.speed import ALSSpeedModelManager
+from oryx_tpu.ops import solver as sv
+
+
+# -- data prep -----------------------------------------------------------
+
+
+def test_parse_and_aggregate_nan_delete():
+    lines = ["u1,i1,2,100", "u1,i1,3,200", "u1,i2,,300", "u1,i2,5,50", "u2,i1,1,10"]
+    batch = d.prepare(lines, implicit=True)
+    agg = {(batch.users.index_to_id[r], batch.items.index_to_id[c]): v
+           for r, c, v in zip(batch.rows, batch.cols, batch.vals)}
+    # u1,i1 summed; u1,i2 deleted by later empty strength
+    assert agg == {("u1", "i1"): 5.0, ("u2", "i1"): 1.0}
+
+
+def test_aggregate_explicit_last_wins():
+    lines = ["u1,i1,2,100", "u1,i1,4,300", "u1,i1,3,200"]
+    batch = d.prepare(lines, implicit=False)
+    assert batch.vals.tolist() == [4.0]
+
+
+def test_decay():
+    now = 86400000 * 10  # day 10
+    its = d.parse_lines(["u,i,8,0"], now_ms=now)  # 10 days old
+    out = d.decay(its, factor=0.5, zero_threshold=0.0, now_ms=now)
+    assert out[0].value == pytest.approx(8 * 0.5**10)
+    # threshold filters decayed-to-nothing values
+    assert d.decay(its, factor=0.5, zero_threshold=0.1, now_ms=now) == []
+
+
+def test_log_strength():
+    lines = ["u,i,1,0"]
+    batch = d.prepare(lines, implicit=True, log_strength=True, epsilon=0.5)
+    assert batch.vals[0] == pytest.approx(np.log1p(1 / 0.5))
+
+
+# -- fold-in math (ALSUtilsTest) ----------------------------------------
+
+
+def test_compute_target_qui_implicit():
+    assert foldin.compute_target_qui(True, 1.0, 0.5) == pytest.approx(0.75)
+    assert np.isnan(foldin.compute_target_qui(True, 1.0, 1.5))  # already >= 1
+    assert foldin.compute_target_qui(True, -1.0, 0.5) == pytest.approx(0.25)
+    assert np.isnan(foldin.compute_target_qui(True, -1.0, -0.5))
+    assert foldin.compute_target_qui(False, 3.3, 0.1) == 3.3
+
+
+def test_compute_updated_xu_moves_estimate_toward_target():
+    rng = np.random.default_rng(5)
+    y = rng.standard_normal((50, 8)).astype(np.float32)
+    solver = sv.get_solver(y.T @ y)
+    yi = y[7]
+    xu = np.zeros(8, dtype=np.float32)
+    before = float(np.dot(xu, yi))
+    new_xu = foldin.compute_updated_xu(solver, 1.0, xu, yi, implicit=True)
+    after = float(np.dot(new_xu, yi))
+    assert after > before  # estimate moved toward 1
+    # no item vector -> no update
+    assert foldin.compute_updated_xu(solver, 1.0, xu, None, True) is None
+    # new user (None Xu) gets a vector
+    assert foldin.compute_updated_xu(solver, 1.0, None, yi, True) is not None
+
+
+# -- training quality (ALSUpdateIT essence) ------------------------------
+
+
+def _synthetic_implicit(n_users=60, n_items=40, rank=4, per_user=8, seed=0):
+    rng = np.random.default_rng(seed)
+    tu = rng.standard_normal((n_users, rank))
+    ti = rng.standard_normal((n_items, rank))
+    scores = tu @ ti.T
+    lines = []
+    for u in range(n_users):
+        for i in np.argsort(-scores[u])[:per_user]:
+            lines.append(f"u{u},i{i},1,{u * 100 + int(i)}")
+    return lines
+
+
+def test_als_train_implicit_auc():
+    batch = d.prepare(_synthetic_implicit(), implicit=True)
+    x, y = tr.als_train(batch, features=8, lam=0.001, alpha=1.0, implicit=True,
+                        iterations=5, chunk=512)
+    auc = ev.area_under_curve(x, y, d.build_rating_batch({}, batch.users, batch.items),
+                              batch, 5)
+    assert auc > 0.85, auc
+
+
+def test_als_train_explicit_rmse():
+    rng = np.random.default_rng(1)
+    tu, ti = rng.standard_normal((50, 4)), rng.standard_normal((30, 4))
+    scores = tu @ ti.T
+    lines = [f"u{u},i{i},{scores[u, i]:.4f},{u}" for u in range(50)
+             for i in rng.choice(30, 12, replace=False)]
+    batch = d.prepare(lines, implicit=False)
+    x, y = tr.als_train(batch, features=6, lam=0.01, alpha=1.0, implicit=False,
+                        iterations=6, chunk=512)
+    assert ev.rmse(x, y, batch) < 0.3 * float(np.std(scores))
+
+
+# -- PMML artifact -------------------------------------------------------
+
+
+def test_pmml_codec_roundtrip(tmp_path):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    y = np.arange(9, dtype=np.float32).reshape(3, 3) * 0.5
+    pmml = pmml_codec.model_to_pmml(
+        x, y, ["uA", "uB"], ["i1", "i2", "i3"], 3, 0.01, 1.5, True, False, 1e-5, tmp_path
+    )
+    meta = pmml_codec.pmml_to_meta(pmml)
+    assert meta["features"] == 3 and meta["implicit"] and meta["alpha"] == 1.5
+    assert meta["x_ids"] == ["uA", "uB"] and meta["y_ids"] == ["i1", "i2", "i3"]
+    back = dict(pmml_codec.read_features(tmp_path / meta["x_dir"]))
+    np.testing.assert_allclose(back["uB"], x[1])
+    assert (tmp_path / "X" / "part-00000.gz").exists()  # gzip part-file layout
+
+
+# -- LSH ----------------------------------------------------------------
+
+
+def test_lsh_config_fraction():
+    n, dd = choose_hash_config(0.3)
+    assert n > 0
+    from oryx_tpu.models.als.lsh import _candidate_fraction
+
+    assert _candidate_fraction(n, dd) <= 0.3 + 1e-9
+
+
+def test_lsh_candidate_buckets_contain_query_bucket():
+    lsh = LocalitySensitiveHash(0.3, 10)
+    v = np.random.default_rng(3).standard_normal(10).astype(np.float32)
+    own = lsh.get_index_for(v)
+    cands = lsh.get_candidate_indices(v)
+    assert own in cands
+    assert len(cands) < lsh.num_buckets
+
+
+# -- serving model -------------------------------------------------------
+
+
+def _serving_model(n_items=200, k=8, sample_rate=1.0):
+    rng = np.random.default_rng(7)
+    m = ALSServingModel(k, True, sample_rate)
+    for i in range(n_items):
+        m.set_item_vector(f"i{i}", rng.standard_normal(k).astype(np.float32))
+    m.set_user_vector("u0", rng.standard_normal(k).astype(np.float32))
+    return m
+
+
+def test_top_n_matches_numpy():
+    m = _serving_model()
+    q = m.get_user_vector("u0")
+    got = m.top_n(q, 10)
+    ids, mat = m.y.materialize()
+    scores = np.asarray(mat) @ q
+    expect = [ids[i] for i in np.argsort(-scores)[:10]]
+    assert [g[0] for g in got] == expect
+    # offset pagination
+    got_off = m.top_n(q, 5, offset=5)
+    assert [g[0] for g in got_off] == expect[5:10]
+
+
+def test_top_n_filters_known_items():
+    m = _serving_model()
+    q = m.get_user_vector("u0")
+    full = m.top_n(q, 5)
+    banned = {full[0][0], full[1][0]}
+    filtered = m.top_n(q, 5, allowed=lambda i: i not in banned)
+    assert banned.isdisjoint({i for i, _ in filtered})
+    assert len(filtered) == 5
+
+
+def test_top_n_rescore():
+    m = _serving_model()
+    q = m.get_user_vector("u0")
+    flipped = m.top_n(q, 3, rescore=lambda i, s: -s)
+    assert flipped[0][1] >= flipped[1][1] >= flipped[2][1]
+
+
+def test_lsh_sampling_reduces_candidates_but_keeps_quality():
+    m_full = _serving_model(500, 16, 1.0)
+    m_lsh = ALSServingModel(16, True, 0.5)
+    for i in m_full.y.ids():
+        m_lsh.set_item_vector(i, m_full.y.get_vector(i))
+    q = m_full.get_user_vector("u0")
+    m_lsh.set_user_vector("u0", q)
+    full = [i for i, _ in m_full.top_n(q, 20)]
+    approx = [i for i, _ in m_lsh.top_n(q, 20)]
+    overlap = len(set(full[:10]) & set(approx)) / 10
+    assert overlap >= 0.3  # approximate, not empty or broken
+
+
+def test_fraction_loaded_gate():
+    m = ALSServingModel(4, True)
+    m.expected_user_ids = {"a", "b"}
+    m.expected_item_ids = {"x", "y"}
+    assert m.get_fraction_loaded() == 0.0
+    m.set_item_vector("x", np.ones(4, dtype=np.float32))
+    assert 0.0 < m.get_fraction_loaded() < 1.0
+
+
+# -- managers end-to-end -------------------------------------------------
+
+
+def _als_config(**extra):
+    base = {"oryx.als.hyperparams.features": 6}
+    base.update(extra)
+    return cfg.overlay_on(base, cfg.get_default())
+
+
+def _publish_model(manager_list, tmp_path):
+    """Train a tiny model, send MODEL + UP protocol to managers like the topics do."""
+    lines = _synthetic_implicit(30, 20, 3, 6)
+    batch = d.prepare(lines, implicit=True)
+    x, y = tr.als_train(batch, features=6, lam=0.001, alpha=1.0, implicit=True,
+                        iterations=3, chunk=256)
+    pmml = pmml_codec.model_to_pmml(
+        np.asarray(x), np.asarray(y), batch.users.index_to_id, batch.items.index_to_id,
+        6, 0.001, 1.0, True, False, 1e-5, tmp_path,
+    )
+    from oryx_tpu.pmml import pmmlutils
+
+    for mgr in manager_list:
+        mgr.consume_key_message("MODEL", pmmlutils.to_string(pmml))
+        for id_, vec in pmml_codec.read_features(tmp_path / "Y"):
+            mgr.consume_key_message("UP", json.dumps(["Y", id_, [float(v) for v in vec]]))
+        known = {}
+        for it in d.parse_lines(lines):
+            known.setdefault(it.user, []).append(it.item)
+        for id_, vec in pmml_codec.read_features(tmp_path / "X"):
+            mgr.consume_key_message(
+                "UP", json.dumps(["X", id_, [float(v) for v in vec], known.get(id_, [])])
+            )
+    return lines, batch
+
+
+def test_speed_manager_folds_in(tmp_path):
+    config = _als_config()
+    mgr = ALSSpeedModelManager(config)
+    _publish_model([mgr], tmp_path)
+    assert mgr.model is not None
+    assert mgr.model.get_fraction_loaded() == 1.0
+    from oryx_tpu.api.keymessage import KeyMessage
+
+    ups = mgr.build_updates([KeyMessage("k", "u1,i1,1,99999")])
+    kinds = {json.loads(u)[0] for u in ups}
+    assert kinds == {"X", "Y"}
+    # new user fold-in produces an X update for an unseen user
+    ups2 = mgr.build_updates([KeyMessage("k", "brand-new-user,i1,1,99999")])
+    assert any(json.loads(u)[0] == "X" and json.loads(u)[1] == "brand-new-user" for u in ups2)
+
+
+def test_serving_manager_end_to_end(tmp_path):
+    config = _als_config()
+    mgr = ALSServingModelManager(config)
+    lines, batch = _publish_model([mgr], tmp_path)
+    model = mgr.get_model()
+    assert model is not None
+    assert model.get_fraction_loaded() == 1.0
+    user = batch.users.index_to_id[0]
+    uv = model.get_user_vector(user)
+    assert uv is not None
+    known = model.get_known_items(user)
+    assert known  # known items arrived with X updates
+    # recommend excluding known items
+    recs = model.top_n(uv, 5, allowed=lambda i: i not in known)
+    assert len(recs) == 5
+    assert known.isdisjoint({i for i, _ in recs})
+    # fold-in estimate for anonymous works through the solver cache
+    solver = model.get_yty_solver()
+    assert solver is not None
+
+
+def test_serving_manager_model_swap_retains(tmp_path):
+    config = _als_config()
+    mgr = ALSServingModelManager(config)
+    _publish_model([mgr], tmp_path)
+    model1 = mgr.get_model()
+    # second MODEL with same features retains instance
+    (tmp_path / "second").mkdir()
+    _publish_model([mgr], tmp_path / "second")
+    assert mgr.get_model() is model1
