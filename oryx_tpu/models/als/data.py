@@ -48,11 +48,13 @@ def parse_line(line: str, now_ms: int | None = None) -> Interaction:
 
 
 def parse_lines(lines: Iterable[str], now_ms: int | None = None) -> list[Interaction]:
+    import csv as _csv
+
     out = []
     for line in lines:
         try:
             out.append(parse_line(line, now_ms))
-        except (ValueError, IndexError):
+        except (ValueError, IndexError, _csv.Error):
             import logging
 
             logging.getLogger(__name__).warning("bad input: %s", line)

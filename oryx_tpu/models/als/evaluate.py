@@ -47,22 +47,21 @@ def area_under_curve(x, y, train: RatingBatch, test: RatingBatch, negatives_per_
 
     rng = rand.get_random()
     pos_rows, pos_cols, neg_cols = [], [], []
-    for r, c in zip(test.rows, test.cols):
+    npp = negatives_per_positive
+    # vectorized rejection sampling: oversample draws per positive, take the
+    # first npp that are not known to the user
+    draws_per_pos = max(4 * npp, 16)
+    all_draws = rng.integers(0, n_items, size=(test.nnz, draws_per_pos))
+    for t, (r, c) in enumerate(zip(test.rows, test.cols)):
         ku = known.get(int(r), set())
         if len(ku) >= n_items:
             continue
-        for _ in range(negatives_per_positive):
-            j = None
-            for _attempt in range(100):
-                cand = int(rng.integers(0, n_items))
-                if cand not in ku:
-                    j = cand
-                    break
-            if j is None:
-                continue  # nearly-saturated user: skip rather than mis-count
+        ku_arr = np.fromiter(ku, dtype=np.int64, count=len(ku))
+        valid = all_draws[t][~np.isin(all_draws[t], ku_arr)][:npp]
+        for j in valid:
             pos_rows.append(int(r))
             pos_cols.append(int(c))
-            neg_cols.append(j)
+            neg_cols.append(int(j))
     if not pos_rows:
         return float("nan")
     rows = jnp.asarray(np.asarray(pos_rows, dtype=np.int32))

@@ -7,11 +7,10 @@ and 1/0 by strength; explicit: the new value), then the factor delta
 dXu = solve(YtY, dQui·Yi) and Xu += dXu. The same math updates item vectors
 from user vectors.
 
-Two forms: a scalar host form (mirror of the reference, used per-interaction
-by managers) and a jit'd batched form used to fold a whole microbatch of
-interactions in one device call (sorted fold order preserved by lax.scan —
-sequential dependence between repeated users is honored like the reference's
-in-order stream).
+The solve itself is a tiny k×k triangular backsubstitution against the cached
+Gramian factorization (ops/solver.py), applied per aggregated interaction in
+timestamp order on host — matching the reference's sequential fold semantics
+(repeated users see each other's updates within a microbatch).
 """
 
 from __future__ import annotations

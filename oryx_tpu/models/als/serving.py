@@ -51,6 +51,23 @@ def _top_k_dot(mat, q, valid, k: int):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
+def _top_k_dot_batch(mat, qs, valid, k: int):
+    scores = qs @ mat.T  # (B, n) — one MXU matmul for the whole query batch
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    # approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's
+    # own approximation); exact on backends without the TPU op
+    return jax.lax.approx_max_k(scores, k, recall_target=0.99)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _top_k_dot_batch_masked(mat, qs, lut, buckets, k: int):
+    scores = qs @ mat.T  # (B, n)
+    valid = jnp.take_along_axis(lut, buckets[None, :], axis=1)  # (B, n)
+    scores = jnp.where(valid, scores, -jnp.inf)
+    return jax.lax.approx_max_k(scores, k, recall_target=0.99)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
     # mean cosine similarity to several query vectors (CosineAverageFunction.java)
     sims = (mat @ qs.T) / jnp.maximum(norms[:, None] * q_norms[None, :], 1e-12)
@@ -107,6 +124,17 @@ class ALSServingModel(ServingModel):
         self.expected_item_ids.discard(item)
         self.yty_cache.set_dirty()
 
+    def bulk_load_users(self, ids, matrix) -> None:
+        """Whole-matrix X handoff keeping model bookkeeping consistent."""
+        self.x.bulk_load(ids, matrix)
+        self.expected_user_ids.difference_update(ids)
+
+    def bulk_load_items(self, ids, matrix) -> None:
+        """Whole-matrix Y handoff keeping model bookkeeping consistent."""
+        self.y.bulk_load(ids, matrix)
+        self.expected_item_ids.difference_update(ids)
+        self.yty_cache.set_dirty()
+
     def get_user_vector(self, user: str):
         return self.x.get_vector(user)
 
@@ -120,6 +148,29 @@ class ALSServingModel(ServingModel):
     def get_known_items(self, user: str) -> set[str]:
         with self._known_lock:
             return set(self.known_items.get(user, ()))
+
+    def get_known_item_vectors_for_user(self, user: str) -> list[tuple[str, np.ndarray]]:
+        """(ALSServingModel.getKnownItemVectorsForUser)"""
+        out = []
+        for item in self.get_known_items(user):
+            v = self.y.get_vector(item)
+            if v is not None:
+                out.append((item, v))
+        return out
+
+    def item_counts(self) -> dict[str, int]:
+        """How many users know each item (ALSServingModel.getItemCounts)."""
+        counts: dict[str, int] = {}
+        with self._known_lock:
+            for items in self.known_items.values():
+                for i in items:
+                    counts[i] = counts.get(i, 0) + 1
+        return counts
+
+    def user_counts(self) -> dict[str, int]:
+        """Known-item count per user (MostActiveUsers source)."""
+        with self._known_lock:
+            return {u: len(items) for u, items in self.known_items.items()}
 
     def all_user_ids(self) -> list[str]:
         return self.x.ids()
@@ -181,6 +232,57 @@ class ALSServingModel(ServingModel):
             if len(out) >= want or k >= snap.n:
                 return out[offset:offset + how_many]
             k = min(snap.n, k * 2)  # widen if filtering consumed candidates
+
+    def top_n_batch(
+        self,
+        query_vecs: np.ndarray,
+        how_many: int,
+        alloweds: "Sequence[Callable[[str], bool] | None] | None" = None,
+    ) -> list[list[tuple[str, float]]]:
+        """Micro-batched top-N: many queries in ONE matmul+top_k device call —
+        the TPU-idiomatic serving pattern (amortizes per-call overhead that the
+        reference spends thread-fanning partition scans)."""
+        snap = self.y_snapshot()
+        if snap.mat is None or snap.n == 0:
+            return [[] for _ in range(len(query_vecs))]
+        qs_host = np.asarray(query_vecs, dtype=np.float32)
+        qs = jnp.asarray(qs_host)
+        filtering = alloweds is not None and any(a is not None for a in alloweds)
+        if self.lsh is None or snap.buckets is None:
+            valid = jnp.ones(snap.n, dtype=bool)
+            k = min(
+                snap.n,
+                _round_up_pow2(max(2 * how_many, 64) if filtering else max(how_many, 16)),
+            )
+            vals, idx = _top_k_dot_batch(snap.mat, qs, valid, k)
+        else:
+            # per-query LSH candidate masks: (B, num_buckets) lookup table
+            # indexed by item bucket on device
+            lut = np.zeros((len(qs_host), self.lsh.num_buckets), dtype=bool)
+            for b, q in enumerate(qs_host):
+                lut[b, self.lsh.get_candidate_indices(q)] = True
+            k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
+            vals, idx = _top_k_dot_batch_masked(
+                snap.mat, qs, jnp.asarray(lut), snap.buckets, k
+            )
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        if not filtering:
+            ids = snap.ids
+            vb, ib = vals[:, :how_many], idx[:, :how_many]
+            return [
+                [(ids[int(i)], float(v)) for v, i in zip(vb[b], ib[b]) if np.isfinite(v)]
+                for b in range(len(query_vecs))
+            ]
+        out = []
+        for b in range(len(query_vecs)):
+            allowed = alloweds[b] if alloweds else None
+            got = self._collect(snap, vals[b], idx[b], how_many, allowed, None)[:how_many]
+            if len(got) < how_many and k < snap.n:
+                # heavy filtering consumed this query's candidates — fall back
+                # to the widening single-query path
+                got = self.top_n(qs_host[b], how_many, 0, allowed, None)
+            out.append(got)
+        return out
 
     def top_n_cosine(
         self,
@@ -250,6 +352,24 @@ class ALSServingModel(ServingModel):
 
     def precompute_solvers(self) -> None:
         self.yty_cache.compute_now()
+
+    def build_temporary_user_vector(
+        self, item_values: Sequence[tuple[str, float]], xu: "np.ndarray | None" = None
+    ) -> "np.ndarray | None":
+        """Fold a context of (item, value) pairs into a temporary user vector
+        (EstimateForAnonymous.buildTemporaryUserVector)."""
+        from oryx_tpu.models.als import foldin
+
+        solver = self.get_yty_solver()
+        if solver is None:
+            return None
+        vec = None if xu is None else np.asarray(xu, dtype=np.float32)
+        for item, value in item_values:
+            yi = self.y.get_vector(item)
+            new_vec = foldin.compute_updated_xu(solver, value, vec, yi, self.implicit)
+            if new_vec is not None:
+                vec = new_vec
+        return vec
 
 
 class ALSServingModelManager(AbstractServingModelManager):

@@ -101,35 +101,34 @@ class ALSUpdate(MLUpdate):
         items = als_data.IDIndexMapping(meta["y_ids"])
         x = _load_matrix(Path(model_parent_path) / meta["x_dir"], users, meta["features"])
         y = _load_matrix(Path(model_parent_path) / meta["y_dir"], items, meta["features"])
-        test_batch = als_data.build_rating_batch(
-            als_data.aggregate(
-                als_data.parse_lines([km.message for km in test_data]),
-                self.implicit,
-                meta["logStrength"],
-                meta["epsilon"],
-            ),
-            users,
-            items,
-        )
+        test_batch = self._eval_batch(test_data, meta, users, items)
         if self.implicit:
             # rebuild the train known-set from the passed train data — stateless,
             # safe under concurrent candidate evaluation
-            train_batch = als_data.build_rating_batch(
-                als_data.aggregate(
-                    als_data.parse_lines([km.message for km in train_data]),
-                    self.implicit,
-                    meta["logStrength"],
-                    meta["epsilon"],
-                ),
-                users,
-                items,
-            )
+            train_batch = self._eval_batch(train_data, meta, users, items)
             score = als_eval.area_under_curve(x, y, train_batch, test_batch)
             log.info("AUC = %s", score)
             return score
         score = -als_eval.rmse(x, y, test_batch)
         log.info("-RMSE = %s", score)
         return score
+
+    def _eval_batch(self, data, meta, users, items):
+        """Parse→decay→aggregate with the SAME pipeline as training, so eval
+        scores compare like with like (reference routes test data through
+        parsedToRatingRDD, which decays — ALSUpdate.java:219)."""
+        interactions = als_data.decay(
+            als_data.parse_lines([km.message for km in data]),
+            self.decay_factor,
+            self.decay_zero_threshold,
+        )
+        return als_data.build_rating_batch(
+            als_data.aggregate(
+                interactions, self.implicit, meta["logStrength"], meta["epsilon"]
+            ),
+            users,
+            items,
+        )
 
     # -- time-ordered split of NEW data (splitNewDataToTrainTest:326-343) ----
     def split_new_data_to_train_test(self, new_data: Sequence[KeyMessage]):

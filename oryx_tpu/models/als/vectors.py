@@ -44,6 +44,16 @@ class FeatureVectorStore:
             self._recent_ids.add(id_)
             self._version += 1
 
+    def bulk_load(self, ids, matrix: np.ndarray) -> None:
+        """Set many vectors in one write-lock pass — the fast path for whole-
+        model handoffs (MODEL-REF factor files, synthetic bench models)."""
+        matrix = np.asarray(matrix, dtype=np.float32)
+        with self._lock.write():
+            for i, id_ in enumerate(ids):
+                self._vectors[id_] = matrix[i]
+                self._recent_ids.add(id_)
+            self._version += 1
+
     def get_vector(self, id_: str) -> "np.ndarray | None":
         with self._lock.read():
             return self._vectors.get(id_)

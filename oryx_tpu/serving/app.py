@@ -1,0 +1,198 @@
+"""Serving runtime: HTTP app factory + layer lifecycle.
+
+Equivalent of the reference's ServingLayer + ModelManagerListener +
+OryxApplication (framework/oryx-lambda-serving/.../ServingLayer.java:121-337,
+ModelManagerListener.java:81-225, OryxApplication.java:54-96): where the
+reference embeds Tomcat and reflection-scans JAX-RS resources, this builds an
+aiohttp application, imports the configured ``application-resources`` modules
+and calls their ``register(app)`` hooks, wires the model-manager lifecycle
+(update-topic consumer thread from ``earliest``, input producer unless
+read-only), and serves with optional basic auth, TLS, and a context path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import importlib
+import logging
+import ssl
+import threading
+
+from aiohttp import web
+
+from oryx_tpu.api.serving import ServingModelManager
+from oryx_tpu.common import classutils
+from oryx_tpu.serving import resource as rsrc
+from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
+
+log = logging.getLogger(__name__)
+
+DEFAULT_RESOURCES = ["oryx_tpu.serving.resources.common"]
+
+
+def make_app(config, manager, input_producer=None) -> web.Application:
+    """Build the aiohttp application with resources from config
+    (OryxApplication.java:54-96)."""
+    middlewares = [rsrc.error_middleware]
+    auth_mw = _basic_auth_middleware(config)
+    if auth_mw is not None:
+        middlewares.append(auth_mw)
+    app = web.Application(middlewares=middlewares)
+    app[rsrc.CONFIG_KEY] = config
+    app[rsrc.MANAGER_KEY] = manager
+    app[rsrc.INPUT_PRODUCER_KEY] = input_producer
+
+    modules = list(DEFAULT_RESOURCES)
+    configured = config.get("oryx.serving.application-resources", None)
+    if configured:
+        if isinstance(configured, str):
+            configured = [m.strip() for m in configured.split(",") if m.strip()]
+        modules.extend(configured)
+    for module_name in modules:
+        module = importlib.import_module(module_name)
+        if not hasattr(module, "register"):
+            raise ValueError(f"resource module {module_name} has no register(app)")
+        module.register(app)
+        log.info("registered resources from %s", module_name)
+
+    context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
+    if context_path not in ("", "/"):
+        outer = web.Application(middlewares=middlewares)
+        outer.add_subapp(context_path, app)
+        return outer
+    return app
+
+
+def _basic_auth_middleware(config):
+    """Optional HTTP basic auth (reference uses a DIGEST realm,
+    ServingLayer.java:293-321; basic-over-TLS is the modern equivalent)."""
+    user = config.get_string("oryx.serving.api.user-name", None)
+    password = config.get_string("oryx.serving.api.password", None)
+    if not user:
+        return None
+    expected = base64.b64encode(f"{user}:{password or ''}".encode()).decode()
+
+    @web.middleware
+    async def auth(request, handler):
+        header = request.headers.get("Authorization", "")
+        if header != f"Basic {expected}":
+            return web.Response(
+                status=401, headers={"WWW-Authenticate": 'Basic realm="Oryx"'}
+            )
+        return await handler(request)
+
+    return auth
+
+
+def _ssl_context(config) -> "ssl.SSLContext | None":
+    """TLS from config: keystore-file = PEM cert chain, key-alias = key file
+    (ServingLayer.makeConnector TLS knobs, :202-255)."""
+    cert = config.get_string("oryx.serving.api.keystore-file", None)
+    if not cert:
+        return None
+    key = config.get_string("oryx.serving.api.key-alias", None)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key or None, config.get_string("oryx.serving.api.keystore-password", None))
+    return ctx
+
+
+class ServingLayer:
+    """Lifecycle: model manager + update consumer + HTTP server
+    (ServingLayer.start/await/close:121-178, ModelManagerListener:102-145)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.id = config.get_string("oryx.id", None)
+        self.update_broker = config.get_string("oryx.update-topic.broker")
+        self.update_topic = config.get_string("oryx.update-topic.message.topic")
+        self.input_broker = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+        self.read_only = config.get_bool("oryx.serving.api.read-only", False)
+        self.port = config.get_int("oryx.serving.api.port")
+        self.manager: ServingModelManager | None = None
+        self._update_iterator: ConsumeDataIterator | None = None
+        self._consumer_thread: threading.Thread | None = None
+        self._server_thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._failure: BaseException | None = None
+
+    def start(self) -> None:
+        # topics must exist (ModelManagerListener.contextInitialized:107-127)
+        if not self.config.get_bool("oryx.serving.no-init-topics", False):
+            for burl, bt in ((self.input_broker, self.input_topic),
+                             (self.update_broker, self.update_topic)):
+                broker = get_broker(burl)
+                if not broker.topic_exists(bt):
+                    broker.create_topic(bt)
+        producer = None
+        if not self.read_only:
+            producer = TopicProducerImpl(self.input_broker, self.input_topic)
+        self.manager = self._load_manager()
+        self._update_iterator = ConsumeDataIterator(
+            get_broker(self.update_broker), self.update_topic, "earliest"
+        )
+
+        def consume():
+            try:
+                self.manager.consume(self._update_iterator)
+            except Exception as e:  # noqa: BLE001
+                if not self._stopped.is_set():
+                    log.exception("fatal error consuming updates; closing layer")
+                    self._failure = e
+                    self.close()
+
+        self._consumer_thread = threading.Thread(
+            target=consume, name="OryxServingLayerUpdateConsumerThread", daemon=True
+        )
+        self._consumer_thread.start()
+
+        app = make_app(self.config, self.manager, producer)
+        sslctx = _ssl_context(self.config)
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "0.0.0.0", self.port, ssl_context=sslctx)
+            loop.run_until_complete(site.start())
+            log.info("serving layer listening on :%d", self.port)
+            self._started.set()
+            try:
+                loop.run_forever()
+            finally:
+                loop.run_until_complete(runner.cleanup())
+                loop.close()
+
+        self._server_thread = threading.Thread(target=serve, name="OryxServingLayer", daemon=True)
+        self._server_thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("serving layer failed to start")
+
+    def _load_manager(self) -> ServingModelManager:
+        name = self.config.get_string("oryx.serving.model-manager-class")
+        if not name:
+            raise ValueError("no class configured at oryx.serving.model-manager-class")
+        return classutils.load_instance_of(name, ServingModelManager, self.config)
+
+    def await_termination(self, timeout: float | None = None) -> None:
+        self._stopped.wait(timeout)
+        if self._failure is not None:
+            raise self._failure
+
+    def close(self) -> None:
+        self._stopped.set()
+        if self._update_iterator is not None:
+            self._update_iterator.close()
+        if self.manager is not None:
+            self.manager.close()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=10)
+        if self._consumer_thread is not None:
+            self._consumer_thread.join(timeout=5)

@@ -1,0 +1,34 @@
+"""Framework-level endpoints: /ready and /error.
+
+Equivalent of the reference's Ready (app/oryx-app-serving/.../Ready.java:33)
+and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.serving import resource as rsrc
+
+
+async def ready(request: web.Request) -> web.Response:
+    """200 when the model is loaded enough, 503 otherwise (HEAD or GET)."""
+    try:
+        rsrc.get_serving_model(request)
+        return web.Response(status=200)
+    except OryxServingException as e:
+        return web.Response(status=e.status)
+
+
+async def error(request: web.Request) -> web.Response:
+    """Error page aggregating status/message (ErrorResource)."""
+    status = request.query.get("status", "500")
+    message = request.query.get("message", "error")
+    return web.json_response({"status": int(status), "error": message}, status=int(status))
+
+
+def register(app: web.Application) -> None:
+    app.router.add_route("GET", "/ready", ready)
+    app.router.add_route("HEAD", "/ready", ready)
+    app.router.add_route("GET", "/error", error)
