@@ -48,20 +48,30 @@ def area_under_curve(x, y, train: RatingBatch, test: RatingBatch, negatives_per_
     rng = rand.get_random()
     pos_rows, pos_cols, neg_cols = [], [], []
     npp = negatives_per_positive
-    # vectorized rejection sampling: oversample draws per positive, take the
-    # first npp that are not known to the user
-    draws_per_pos = max(4 * npp, 16)
-    all_draws = rng.integers(0, n_items, size=(test.nnz, draws_per_pos))
-    for t, (r, c) in enumerate(zip(test.rows, test.cols)):
-        ku = known.get(int(r), set())
+    # per-user rejection sampling with top-up retries: draw sizes stay
+    # proportional to each user's need (bounded host memory) and every
+    # positive reliably gets npp negatives unless the user has seen
+    # nearly every item
+    by_user: dict[int, list[int]] = {}
+    for r, c in zip(test.rows, test.cols):
+        by_user.setdefault(int(r), []).append(int(c))
+    for r, cols in by_user.items():
+        ku = known.get(r, set())
         if len(ku) >= n_items:
             continue
         ku_arr = np.fromiter(ku, dtype=np.int64, count=len(ku))
-        valid = all_draws[t][~np.isin(all_draws[t], ku_arr)][:npp]
-        for j in valid:
-            pos_rows.append(int(r))
-            pos_cols.append(int(c))
-            neg_cols.append(int(j))
+        need = npp * len(cols)
+        negs: list[int] = []
+        for _ in range(100):
+            if len(negs) >= need:
+                break
+            draw = rng.integers(0, n_items, size=max(2 * (need - len(negs)), 16))
+            negs.extend(draw[~np.isin(draw, ku_arr)][: need - len(negs)].tolist())
+        for i, c in enumerate(cols):
+            for j in negs[i * npp : (i + 1) * npp]:
+                pos_rows.append(r)
+                pos_cols.append(c)
+                neg_cols.append(j)
     if not pos_rows:
         return float("nan")
     rows = jnp.asarray(np.asarray(pos_rows, dtype=np.int32))

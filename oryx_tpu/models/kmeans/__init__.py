@@ -1,0 +1,2 @@
+"""k-means clustering vertical: TPU trainer, eval metrics, PMML codec,
+speed + serving models (reference app/* kmeans components, SURVEY §2.8-2.11)."""

@@ -192,7 +192,10 @@ class ServingLayer:
             self.manager.close()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._server_thread is not None:
+        if self._server_thread is not None and self._server_thread is not threading.current_thread():
             self._server_thread.join(timeout=10)
-        if self._consumer_thread is not None:
+        if (
+            self._consumer_thread is not None
+            and self._consumer_thread is not threading.current_thread()
+        ):
             self._consumer_thread.join(timeout=5)

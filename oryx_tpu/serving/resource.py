@@ -72,10 +72,12 @@ def check_exists(value, what: str) -> Any:
 
 
 def _to_csv_row(item: Any) -> str:
+    from oryx_tpu.common import textutils
+
     if isinstance(item, dict):
-        return ",".join(str(v) for v in item.values())
+        return textutils.join_delimited(list(item.values()))
     if isinstance(item, (list, tuple)):
-        return ",".join(str(v) for v in item)
+        return textutils.join_delimited(item)
     return str(item)
 
 
@@ -157,11 +159,10 @@ async def read_body_lines(request: web.Request) -> list[str]:
             lines.extend(_decode_maybe_compressed(data, part.headers.get("Content-Type", "")))
         return lines
     data = await request.read()
-    encoding = request.headers.get("Content-Encoding", "")
-    return _decode_maybe_compressed(data, content_type, encoding)
+    return _decode_maybe_compressed(data, content_type)
 
 
-def _decode_maybe_compressed(data: bytes, content_type: str, encoding: str = "") -> list[str]:
+def _decode_maybe_compressed(data: bytes, content_type: str) -> list[str]:
     # sniff by magic bytes: aiohttp already transparently decompresses
     # Content-Encoding bodies, so the header alone is not trustworthy
     if data[:2] == b"\x1f\x8b":

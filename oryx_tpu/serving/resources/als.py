@@ -10,6 +10,7 @@ All endpoints produce JSON (default) or CSV (Accept: text/csv).
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 import numpy as np
@@ -41,9 +42,7 @@ def _rescorer_provider(request: web.Request):
 
 
 async def _run(request, fn, *args):
-    import asyncio
-
-    return await asyncio.get_event_loop().run_in_executor(None, fn, *args)
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
 
 
 def _combine_allowed_rescore(allowed, rescorer):

@@ -1,0 +1,306 @@
+"""k-means vertical tests: schema, trainer, eval metrics, PMML round-trip,
+batch update, speed + serving managers (mirrors reference KMeansUpdateIT /
+KMeansEvalIT / KMeansSpeedIT / KMeansPMMLUtilsTest / InputSchemaTest,
+SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import config as cfg
+from oryx_tpu.models import pmml_common
+from oryx_tpu.models.kmeans import evaluate as kmeval
+from oryx_tpu.models.kmeans import pmml_codec
+from oryx_tpu.models.kmeans import train as kmtrain
+from oryx_tpu.models.kmeans.model import ClusterInfo, closest_cluster
+from oryx_tpu.models.kmeans.serving import KMeansServingModelManager
+from oryx_tpu.models.kmeans.speed import KMeansSpeedModelManager
+from oryx_tpu.models.kmeans.update import KMeansUpdate
+from oryx_tpu.models.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.pmml import pmmlutils
+
+
+def _config(extra=None):
+    over = {
+        "oryx.input-schema.num-features": 2,
+        "oryx.input-schema.categorical-features": [],
+        "oryx.kmeans.hyperparams.k": 3,
+        "oryx.kmeans.runs": 2,
+        "oryx.kmeans.iterations": 10,
+        "oryx.ml.eval.test-fraction": 0.2,
+    }
+    over.update(extra or {})
+    return cfg.overlay_on(over, cfg.get_default())
+
+
+def _blobs(n_per=60, centers=((0, 0), (10, 10), (-10, 6)), seed=7):
+    rng = np.random.default_rng(seed)
+    pts = np.concatenate(
+        [rng.normal(c, 0.5, size=(n_per, 2)) for c in centers]
+    )
+    rng.shuffle(pts)
+    return pts
+
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+
+def test_input_schema_generated_names_and_predictors():
+    schema = InputSchema(_config())
+    assert schema.feature_names == ["0", "1"]
+    assert schema.num_predictors == 2
+    assert schema.is_numeric("0") and not schema.is_categorical("1")
+
+
+def test_input_schema_full():
+    config = _config(
+        {
+            "oryx.input-schema.feature-names": ["id", "a", "b", "c", "label"],
+            "oryx.input-schema.id-features": ["id"],
+            "oryx.input-schema.ignored-features": ["c"],
+            "oryx.input-schema.numeric-features": ["a", "b"],
+            "oryx.input-schema.target-feature": "label",
+        }
+    )
+    schema = InputSchema(config)
+    assert schema.num_features == 5 and schema.num_predictors == 2
+    assert schema.is_id("id") and schema.is_categorical("label")
+    assert schema.has_target() and schema.target_feature_index == 4
+    assert schema.feature_to_predictor_index(1) == 0
+    assert schema.predictor_to_feature_index(1) == 2
+    vec = pmml_common.features_from_tokens(["x", "1.5", "2.5", "9", "pos"], schema)
+    assert vec.tolist() == [1.5, 2.5]
+
+
+def test_input_schema_errors():
+    with pytest.raises(ValueError):
+        InputSchema(cfg.overlay_on({"oryx.input-schema.num-features": 0}, cfg.get_default()))
+    with pytest.raises(ValueError):
+        InputSchema(
+            _config({"oryx.input-schema.target-feature": "nope"})
+        )
+
+
+def test_categorical_value_encodings():
+    enc = CategoricalValueEncodings({0: ["a", "b", "c"], 2: ["x", "y"]})
+    assert enc.get_value_encoding_map(0) == {"a": 0, "b": 1, "c": 2}
+    assert enc.get_encoding_value_map(2)[1] == "y"
+    assert enc.get_value_count(0) == 3
+    assert enc.get_category_counts() == {0: 3, 2: 2}
+
+
+# ---------------------------------------------------------------------------
+# trainer + evals
+# ---------------------------------------------------------------------------
+
+
+def test_kmeans_train_recovers_blobs():
+    pts = _blobs()
+    centers, counts = kmtrain.kmeans_train(pts, 3, iterations=15, runs=2)
+    assert centers.shape == (3, 2)
+    assert counts.sum() == len(pts)
+    # each true blob center has a learned center nearby
+    for true in ((0, 0), (10, 10), (-10, 6)):
+        assert np.linalg.norm(centers - np.asarray(true), axis=1).min() < 1.0
+
+
+def test_kmeans_train_random_init_and_small_n():
+    pts = np.asarray([[0.0, 0.0], [1.0, 1.0]])
+    centers, counts = kmtrain.kmeans_train(pts, 5, init=kmtrain.INIT_RANDOM)
+    assert len(centers) == 2  # k clamped to n
+
+
+def test_eval_metrics_prefer_true_k():
+    pts = _blobs()
+    good_centers, good_counts = kmtrain.kmeans_train(pts, 3, iterations=15, runs=2)
+    good = [ClusterInfo(i, good_centers[i], int(good_counts[i])) for i in range(3)]
+    bad = [ClusterInfo(0, np.asarray([0.0, 5.0]), 1), ClusterInfo(1, np.asarray([1.0, 5.0]), 1)]
+
+    assert kmeval.silhouette_coefficient(good, pts) > 0.7
+    assert kmeval.silhouette_coefficient(good, pts) > kmeval.silhouette_coefficient(bad, pts)
+    assert kmeval.sum_squared_error(good, pts) < kmeval.sum_squared_error(bad, pts)
+    assert kmeval.davies_bouldin_index(good, pts) < kmeval.davies_bouldin_index(bad, pts)
+    assert kmeval.dunn_index(good, pts) > kmeval.dunn_index(bad, pts)
+
+
+def test_silhouette_sampling_cap():
+    pts = _blobs(n_per=200)
+    s = kmeval.silhouette_coefficient(
+        [ClusterInfo(0, np.asarray([0.0, 0.0]), 1), ClusterInfo(1, np.asarray([10.0, 10.0]), 1),
+         ClusterInfo(2, np.asarray([-10.0, 6.0]), 1)],
+        pts,
+        max_sample=100,
+    )
+    assert 0.5 < s <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# PMML round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_pmml_roundtrip_and_validation():
+    schema = InputSchema(_config())
+    clusters = [
+        ClusterInfo(0, np.asarray([1.5, -2.0]), 10),
+        ClusterInfo(1, np.asarray([0.0, 4.25]), 20),
+    ]
+    pmml = pmml_codec.clustering_model_to_pmml(clusters, schema)
+    pmml_codec.validate_pmml_vs_schema(pmml, schema)
+    s = pmmlutils.to_string(pmml)
+    back = pmml_codec.read(pmmlutils.from_string(s))
+    assert [c.id for c in back] == [0, 1]
+    assert back[0].count == 10
+    np.testing.assert_allclose(back[1].center, [0.0, 4.25])
+
+    other_schema = InputSchema(_config({"oryx.input-schema.num-features": 3}))
+    with pytest.raises(ValueError):
+        pmml_codec.validate_pmml_vs_schema(pmml, other_schema)
+
+
+def test_cluster_info_update_running_mean():
+    c = ClusterInfo(0, np.asarray([1.0, 1.0]), 2)
+    c.update(np.asarray([4.0, 4.0]), 1)
+    np.testing.assert_allclose(c.center, [2.0, 2.0])
+    assert c.count == 3
+
+
+def test_closest_cluster():
+    clusters = [ClusterInfo(5, np.asarray([0.0, 0.0]), 1), ClusterInfo(9, np.asarray([10.0, 0.0]), 1)]
+    c, d = closest_cluster(clusters, np.asarray([9.0, 0.0]))
+    assert c.id == 9 and d == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# batch update (KMeansUpdateIT analogue)
+# ---------------------------------------------------------------------------
+
+
+class _CaptureProducer:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, key, message):
+        self.sent.append((key, message))
+
+
+def test_kmeans_update_end_to_end(tmp_path):
+    config = _config()
+    update = KMeansUpdate(config)
+    data = [
+        KeyMessage(None, f"{p[0]},{p[1]}") for p in _blobs(n_per=40)
+    ]
+    producer = _CaptureProducer()
+    update.run_update(None, 1234567890000, data, [], str(tmp_path / "model"), producer)
+    keys = [k for k, _ in producer.sent]
+    assert keys == ["MODEL"]
+    pmml = pmmlutils.from_string(producer.sent[0][1])
+    clusters = pmml_codec.read(pmml)
+    assert len(clusters) == 3
+    assert sum(c.count for c in clusters) > 0
+    # model promoted into the timestamped model dir
+    assert (tmp_path / "model").exists()
+
+
+# ---------------------------------------------------------------------------
+# speed + serving managers
+# ---------------------------------------------------------------------------
+
+
+def _model_message():
+    schema = InputSchema(_config())
+    clusters = [
+        ClusterInfo(0, np.asarray([0.0, 0.0]), 10),
+        ClusterInfo(1, np.asarray([10.0, 10.0]), 10),
+    ]
+    return pmmlutils.to_string(pmml_codec.clustering_model_to_pmml(clusters, schema))
+
+
+def test_speed_manager_emits_centroid_updates():
+    mgr = KMeansSpeedModelManager(_config())
+    assert mgr.build_updates([]) == []
+    mgr.consume_key_message("MODEL", _model_message())
+    mgr.consume_key_message("UP", "[0, [0,0], 5]")  # hearing own update: ignored
+    updates = mgr.build_updates(
+        [KeyMessage(None, "0.5,0.5"), KeyMessage(None, "9.5,9.5"), KeyMessage(None, "10.5,10.5")]
+    )
+    assert len(updates) == 2
+    import json
+
+    by_id = {json.loads(u)[0]: json.loads(u) for u in updates}
+    # cluster 0 absorbed one point at (.5,.5): mean moves toward it
+    assert by_id[0][2] == 11
+    assert by_id[1][2] == 12
+    assert 0 < by_id[0][1][0] < 0.5
+
+
+def test_serving_manager_model_and_up():
+    mgr = KMeansServingModelManager(_config())
+    assert mgr.get_model() is None
+    mgr.consume_key_message("UP", "[0, [1,1], 3]")  # before model: ignored
+    mgr.consume_key_message("MODEL", _model_message())
+    model = mgr.get_model()
+    cid, dist = model.nearest_cluster(np.asarray([9.0, 9.0]))
+    assert cid == 1 and dist == pytest.approx(np.sqrt(2))
+    mgr.consume_key_message("UP", "[1, [8.0, 8.0], 42]")
+    cid2, dist2 = model.nearest_cluster(np.asarray([9.0, 9.0]))
+    assert cid2 == 1 and dist2 == pytest.approx(np.sqrt(2))
+    assert model.clusters[1].count == 42
+    assert model.get_fraction_loaded() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# REST endpoints over real HTTP (AssignTest/DistanceToNearestTest/AddTest)
+# ---------------------------------------------------------------------------
+
+
+def test_kmeans_endpoints(tmp_path):
+    import httpx
+
+    from oryx_tpu.common import ioutils
+    from oryx_tpu.serving.app import ServingLayer
+    from oryx_tpu.transport import topic as tp
+
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = _config(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.kmeans.serving.KMeansServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.serving.resources.kmeans",
+        }
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    tp.TopicProducerImpl("memory:", "OryxUpdate").send("MODEL", _model_message())
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+            import time as _t
+
+            deadline = _t.monotonic() + 30
+            while _t.monotonic() < deadline:
+                if client.get("/ready").status_code == 200:
+                    break
+                _t.sleep(0.1)
+            else:
+                pytest.fail("serving layer never became ready")
+
+            assert client.get("/assign/9.5,9.5").text == "1"
+            r = client.post("/assign", content="0.1,0.1\n10.1,10.1\n")
+            assert r.text.splitlines() == ["0", "1"]
+            d = float(client.get("/distanceToNearest/10,11").text)
+            assert d == pytest.approx(1.0)
+            assert client.get("/assign/bad,datum").status_code == 400
+            # /add writes to the input topic
+            assert client.post("/add/1.0,2.0").status_code == 204
+            assert client.post("/add", content="3,4\n5,6\n").status_code == 204
+            broker = tp.get_broker("memory:")
+            msgs = [km.message for km in broker.read("OryxInput", 0, 100)]
+            assert msgs == ["1.0,2.0", "3,4", "5,6"]
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
