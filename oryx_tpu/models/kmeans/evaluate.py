@@ -60,7 +60,10 @@ def davies_bouldin_index(clusters: Sequence[ClusterInfo], points: np.ndarray) ->
     with np.errstate(divide="ignore", invalid="ignore"):
         ratio = scatter_sum / center_d
     np.fill_diagonal(ratio, 0.0)
-    ratio = np.nan_to_num(ratio, nan=0.0, posinf=0.0)
+    # coincident centers ⇒ infinite ratio must PROPAGATE so the degenerate
+    # model ranks worst (reference DaviesBouldinIndex.java keeps Infinity);
+    # only a 0/0 (both scatters zero too) is treated as no-contribution
+    ratio = np.nan_to_num(ratio, nan=0.0)
     return float(ratio.max(axis=1).mean())
 
 
@@ -97,11 +100,16 @@ def silhouette_coefficient(
     n, k = len(points), len(centers)
     if n == 0:
         return 0.0
-    d = distances_to_centers(points, points)  # (S, S)
     one_hot = np.zeros((n, k))
     one_hot[np.arange(n), idx] = 1.0
     counts = one_hot.sum(axis=0)  # (k,)
-    sums_to_cluster = d @ one_hot  # (S, k) total distance to each cluster's points
+    # (S, k) total distance to each cluster's points, in row blocks so the
+    # full S×S pairwise matrix never materializes (O(block·S) transient)
+    sums_to_cluster = np.empty((n, k))
+    block = 1024
+    for start in range(0, n, block):
+        d = distances_to_centers(points[start : start + block], points)
+        sums_to_cluster[start : start + block] = d @ one_hot
     own = counts[idx]
     # a: mean distance to *other* points of own cluster (n−1 divisor)
     with np.errstate(divide="ignore", invalid="ignore"):

@@ -43,7 +43,8 @@ class KMeansUpdate(MLUpdate):
         self.iterations = config.get_int("oryx.kmeans.iterations")
         self.hyper_params = [hp.from_config(config, "oryx.kmeans.hyperparams.k")]
         self.input_schema = InputSchema(config)
-        assert self.iterations > 0 and self.runs > 0
+        if self.iterations <= 0 or self.runs <= 0:
+            raise ValueError("iterations and runs must be positive")
         if self.initialization_strategy not in (
             kmtrain.INIT_RANDOM,
             kmtrain.INIT_KMEANS_PARALLEL,
@@ -77,7 +78,8 @@ class KMeansUpdate(MLUpdate):
     # -- train (buildModel:107-122) -----------------------------------------
     def build_model(self, context, train_data, hyper_parameters, candidate_path: Path):
         k = int(hyper_parameters[0])
-        assert k > 0
+        if k <= 0:
+            raise ValueError(f"k must be positive: {k}")
         points = self._to_points(train_data)
         if len(points) == 0:
             return None

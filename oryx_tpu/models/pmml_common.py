@@ -135,9 +135,16 @@ def validate_feature_names(pmml: ET.Element, schema: InputSchema, what: str) -> 
 
 
 def features_from_tokens(tokens: Sequence[str], schema: InputSchema) -> np.ndarray:
-    """Datum tokens → dense numeric predictor vector (KMeansUtils.featuresFromTokens:62-71)."""
+    """Datum tokens → dense numeric predictor vector (KMeansUtils.featuresFromTokens:62-71).
+
+    Rows with more tokens than the schema has features are rejected, like the
+    reference's ArrayIndexOutOfBoundsException → bad-input path."""
+    if len(tokens) > schema.num_features:
+        raise IndexError(
+            f"{len(tokens)} tokens but schema has {schema.num_features} features"
+        )
     features = np.zeros(schema.num_predictors, dtype=np.float64)
-    for i in range(min(len(tokens), schema.num_features)):
+    for i in range(len(tokens)):
         if schema.is_active(i) and not schema.is_target(i):
             features[schema.feature_to_predictor_index(i)] = float(tokens[i])
     return features

@@ -1,0 +1,3 @@
+"""Random decision forest vertical: TPU histogram trainer, PMML codec,
+speed and serving tiers (reference: app/oryx-app-{common,mllib,app,serving}
+rdf packages)."""

@@ -105,6 +105,10 @@ class InputSchema:
     def has_target(self) -> bool:
         return self.target_feature is not None
 
+    def is_classification(self) -> bool:
+        """Categorical target = classification (InputSchema.isClassification)."""
+        return self.has_target() and self.is_categorical(self.target_feature)
+
     def feature_to_predictor_index(self, feature_index: int) -> int:
         return self._all_to_predictor[feature_index]
 

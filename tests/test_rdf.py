@@ -1,0 +1,489 @@
+"""RDF vertical tests: tree structures, predictions, TPU histogram trainer,
+PMML round-trip, batch update, speed + serving managers, REST endpoints
+(mirrors reference DecisionTreeTest / RDFPMMLUtilsTest / RDFUpdateIT /
+RDFSpeedIT / PredictTest / ClassificationDistributionTest, SURVEY §4)."""
+
+import json
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils, rand
+from oryx_tpu.models.classreg import (
+    CategoricalFeature,
+    CategoricalPrediction,
+    Example,
+    NumericFeature,
+    NumericPrediction,
+    example_from_tokens,
+    vote_on_feature,
+)
+from oryx_tpu.models.rdf import pmml_codec
+from oryx_tpu.models.rdf import train as rdftrain
+from oryx_tpu.models.rdf.serving import RDFServingModelManager
+from oryx_tpu.models.rdf.speed import RDFSpeedModelManager
+from oryx_tpu.models.rdf.tree import (
+    CategoricalDecision,
+    DecisionForest,
+    DecisionNode,
+    DecisionTree,
+    NumericDecision,
+    TerminalNode,
+)
+from oryx_tpu.models.rdf.update import RDFUpdate
+from oryx_tpu.models.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.pmml import pmmlutils
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+
+def _cls_config(extra=None):
+    over = {
+        "oryx.input-schema.feature-names": ["a", "b", "label"],
+        "oryx.input-schema.categorical-features": ["label"],
+        "oryx.input-schema.target-feature": "label",
+        "oryx.rdf.num-trees": 3,
+        "oryx.ml.eval.test-fraction": 0.25,
+    }
+    over.update(extra or {})
+    return cfg.overlay_on(over, cfg.get_default())
+
+
+def _reg_config(extra=None):
+    over = {
+        "oryx.input-schema.feature-names": ["a", "b", "y"],
+        "oryx.input-schema.categorical-features": [],
+        "oryx.input-schema.target-feature": "y",
+        "oryx.rdf.num-trees": 3,
+        "oryx.ml.eval.test-fraction": 0.25,
+    }
+    over.update(extra or {})
+    return cfg.overlay_on(over, cfg.get_default())
+
+
+def _cls_lines(n=200, seed=5):
+    """Separable two-class data: label depends on whether a > b."""
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(-5, 5, size=(n, 2))
+    return [f"{a:.4f},{b:.4f},{'hi' if a > b else 'lo'}" for a, b in pts]
+
+
+# ---------------------------------------------------------------------------
+# tree structures (DecisionTreeTest equivalents)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_tree():
+    #         r: a >= 1 ?
+    #    r- : lo leaf        r+ : b in {0} ?
+    #                   r+- : mid      r++ : hi
+    pos = DecisionNode(
+        "r+",
+        CategoricalDecision(1, {0}, default_decision=False),
+        TerminalNode("r+-", CategoricalPrediction([0, 5, 1])),
+        TerminalNode("r++", CategoricalPrediction([0, 0, 9])),
+    )
+    root = DecisionNode(
+        "r",
+        NumericDecision(0, 1.0, default_decision=True),
+        TerminalNode("r-", CategoricalPrediction([7, 1, 0])),
+        pos,
+    )
+    return DecisionTree(root)
+
+
+def test_tree_navigation_and_prediction():
+    tree = _tiny_tree()
+    ex = Example(None, [NumericFeature(0.5), CategoricalFeature(1)])
+    assert tree.find_terminal(ex).id == "r-"
+    ex2 = Example(None, [NumericFeature(2.0), CategoricalFeature(0)])
+    assert tree.find_terminal(ex2).id == "r++"
+    ex3 = Example(None, [NumericFeature(2.0), CategoricalFeature(2)])
+    assert tree.find_terminal(ex3).id == "r+-"
+    # threshold is >= (NumericDecision.java:104)
+    ex4 = Example(None, [NumericFeature(1.0), CategoricalFeature(2)])
+    assert tree.find_terminal(ex4).id.startswith("r+")
+
+
+def test_tree_missing_feature_follows_default():
+    tree = _tiny_tree()
+    # missing a → default right; missing b → default left
+    ex = Example(None, [None, None])
+    assert tree.find_terminal(ex).id == "r+-"
+
+
+def test_find_by_id():
+    tree = _tiny_tree()
+    assert tree.find_by_id("r").id == "r"
+    assert tree.find_by_id("r+-").id == "r+-"
+    assert tree.find_by_id("r++").id == "r++"
+    with pytest.raises(ValueError):
+        tree.find_by_id("x")
+
+
+# ---------------------------------------------------------------------------
+# predictions (NumericPrediction/CategoricalPrediction/WeightedPrediction)
+# ---------------------------------------------------------------------------
+
+
+def test_numeric_prediction_running_mean():
+    p = NumericPrediction(10.0, 2)
+    p.update(4.0, 2)  # (10*2 + 4*2) / 4 = 7
+    assert p.prediction == pytest.approx(7.0)
+    assert p.count == 4
+
+
+def test_categorical_prediction_counts():
+    p = CategoricalPrediction([2.0, 1.0, 1.0])
+    assert p.most_probable_category_encoding == 0
+    p.update(2, 5)
+    assert p.most_probable_category_encoding == 2
+    assert p.category_probabilities == pytest.approx([2 / 9, 1 / 9, 6 / 9])
+
+
+def test_weighted_vote():
+    cat = vote_on_feature(
+        [CategoricalPrediction([1, 0]), CategoricalPrediction([0, 1])], [3.0, 1.0]
+    )
+    assert cat.category_probabilities == pytest.approx([0.75, 0.25])
+    num = vote_on_feature(
+        [NumericPrediction(1.0, 1), NumericPrediction(3.0, 1)], [1.0, 1.0]
+    )
+    assert num.prediction == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# trainer
+# ---------------------------------------------------------------------------
+
+
+def test_forest_train_classification_separable():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-5, 5, size=(300, 2))
+    y = (X[:, 0] > X[:, 1]).astype(np.int64)
+    trees, importances = rdftrain.forest_train(
+        X, y, [False, False], [0, 0],
+        task=rdftrain.CLASSIFICATION, n_classes=2, num_trees=5,
+        max_depth=6, max_split_candidates=32, impurity="entropy",
+        rng=np.random.default_rng(1),
+    )
+    assert len(trees) == 5
+    assert importances.sum() == pytest.approx(1.0)
+    # train accuracy via the trained structure itself
+    correct = 0
+    for i in range(len(X)):
+        votes = []
+        for root in trees:
+            node = root
+            while not node.is_leaf:
+                s = node.split
+                go_right = X[i, s.predictor_index] > s.threshold
+                node = node.positive if go_right else node.negative
+            votes.append(np.argmax(node.class_counts))
+        if np.bincount(votes).argmax() == y[i]:
+            correct += 1
+    assert correct / len(X) > 0.9
+
+
+def test_forest_train_regression():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 10, size=(300, 1))
+    y = np.where(X[:, 0] > 5, 20.0, -3.0) + rng.normal(0, 0.1, 300)
+    trees, _ = rdftrain.forest_train(
+        X, y, [False], [0],
+        task=rdftrain.REGRESSION, num_trees=1, max_depth=3,
+        max_split_candidates=32, rng=np.random.default_rng(1),
+    )
+    root = trees[0]
+    assert not root.is_leaf
+    # root split should be near 5 and leaves near the two levels
+    assert 3.0 < root.split.threshold < 7.0
+
+
+def test_forest_train_categorical_feature():
+    rng = np.random.default_rng(0)
+    cats = rng.integers(0, 4, size=400)
+    X = cats[:, None].astype(np.float64)
+    y = np.isin(cats, [1, 3]).astype(np.int64)  # classes determined by category
+    trees, _ = rdftrain.forest_train(
+        X, y, [True], [4],
+        task=rdftrain.CLASSIFICATION, n_classes=2, num_trees=1,
+        max_depth=3, max_split_candidates=8, impurity="gini",
+        rng=np.random.default_rng(1),
+    )
+    root = trees[0]
+    assert not root.is_leaf
+    assert root.split.left_categories is not None
+    left = set(root.split.left_categories)
+    # the split must separate {1,3} from {0,2}
+    assert left in ({1, 3}, {0, 2})
+
+
+# ---------------------------------------------------------------------------
+# PMML round trip
+# ---------------------------------------------------------------------------
+
+
+def _trained_forest_pmml():
+    config = _cls_config()
+    schema = InputSchema(config)
+    encodings = CategoricalValueEncodings({2: ["hi", "lo"]})
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-5, 5, size=(200, 2))
+    v2e = encodings.get_value_encoding_map(2)
+    y = np.where(X[:, 0] > X[:, 1], v2e["hi"], v2e["lo"])
+    trees, importances = rdftrain.forest_train(
+        X, y.astype(np.int64), [False, False], [0, 0],
+        task=rdftrain.CLASSIFICATION, n_classes=2, num_trees=3,
+        max_depth=5, max_split_candidates=16, impurity="entropy",
+        rng=np.random.default_rng(4),
+    )
+    pmml = pmml_codec.forest_to_pmml(
+        trees, importances, schema, encodings,
+        max_depth=5, max_split_candidates=16, impurity="entropy",
+    )
+    return pmml, schema, encodings
+
+
+def test_pmml_round_trip_classification():
+    pmml, schema, _ = _trained_forest_pmml()
+    pmml_codec.validate_pmml_vs_schema(pmml, schema)
+    assert pmmlutils.get_extension_value(pmml, "maxDepth") == "5"
+    assert pmmlutils.get_extension_value(pmml, "impurity") == "entropy"
+    # survives string serialization (the MODEL message path)
+    pmml2 = pmmlutils.from_string(pmmlutils.to_string(pmml))
+    forest, encodings = pmml_codec.read(pmml2)
+    assert len(forest.trees) == 3
+    # prediction matches the raw training rule on clear points
+    ex = example_from_tokens(["4.0", "-4.0", ""], schema, encodings)
+    pred = forest.predict(ex)
+    e2v = encodings.get_encoding_value_map(2)
+    assert e2v[pred.most_probable_category_encoding] == "hi"
+    ex2 = example_from_tokens(["-4.0", "4.0", ""], schema, encodings)
+    assert e2v[forest.predict(ex2).most_probable_category_encoding] == "lo"
+
+
+def test_pmml_single_tree_is_bare_treemodel():
+    config = _cls_config({"oryx.rdf.num-trees": 1})
+    schema = InputSchema(config)
+    encodings = CategoricalValueEncodings({2: ["hi", "lo"]})
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-5, 5, size=(100, 2))
+    y = (X[:, 0] > X[:, 1]).astype(np.int64)
+    trees, imp = rdftrain.forest_train(
+        X, y, [False, False], [0, 0],
+        task=rdftrain.CLASSIFICATION, n_classes=2, num_trees=1,
+        max_depth=4, max_split_candidates=16, impurity="gini",
+        rng=np.random.default_rng(4),
+    )
+    pmml = pmml_codec.forest_to_pmml(
+        trees, imp, schema, encodings,
+        max_depth=4, max_split_candidates=16, impurity="gini",
+    )
+    assert pmmlutils.find(pmml, "MiningModel") is None
+    assert pmmlutils.find(pmml, "TreeModel") is not None
+    forest, _ = pmml_codec.read(pmml)
+    assert len(forest.trees) == 1
+
+
+def test_validate_rejects_wrong_schema():
+    pmml, _, _ = _trained_forest_pmml()
+    bad = InputSchema(_reg_config())
+    with pytest.raises(ValueError):
+        pmml_codec.validate_pmml_vs_schema(pmml, bad)
+
+
+# ---------------------------------------------------------------------------
+# batch update (RDFUpdateIT equivalent)
+# ---------------------------------------------------------------------------
+
+
+def test_rdf_update_build_and_evaluate_classification():
+    rand.use_test_seed()
+    config = _cls_config()
+    update = RDFUpdate(config)
+    data = [KeyMessage(None, line) for line in _cls_lines(240)]
+    train, test = data[:200], data[200:]
+    pmml = update.build_model(None, train, [16, 6, "entropy"], None)
+    assert pmml is not None
+    acc = update.evaluate(None, pmml, None, test, train)
+    assert acc > 0.85
+
+
+def test_rdf_update_regression():
+    rand.use_test_seed()
+    config = _reg_config({"oryx.rdf.num-trees": 1})
+    update = RDFUpdate(config)
+    rng = np.random.default_rng(11)
+    lines = []
+    for _ in range(240):
+        a, b = rng.uniform(0, 10, 2)
+        lines.append(f"{a:.3f},{b:.3f},{a * 2 + b:.3f}")
+    data = [KeyMessage(None, line) for line in lines]
+    pmml = update.build_model(None, data[:200], [32, 8, "variance"], None)
+    assert pmml is not None
+    neg_rmse = update.evaluate(None, pmml, None, data[200:], data[:200])
+    assert neg_rmse < 0  # it is -RMSE
+    assert -neg_rmse < 3.0  # target spans ~[0,30]; tree should fit well
+
+
+def test_rdf_update_hyperparams_from_config():
+    update = RDFUpdate(_cls_config())
+    combos = [hp.get_trial_values(1)[0] for hp in update.get_hyper_parameter_values()]
+    assert combos == [100, 8, "entropy"]
+
+
+# ---------------------------------------------------------------------------
+# speed manager (RDFSpeedIT equivalent)
+# ---------------------------------------------------------------------------
+
+
+def _published_model_message():
+    pmml, schema, encodings = _trained_forest_pmml()
+    return pmmlutils.to_string(pmml)
+
+
+def test_speed_manager_emits_leaf_stats():
+    config = _cls_config()
+    manager = RDFSpeedModelManager(config)
+    manager.consume_key_message("MODEL", _published_model_message())
+    assert manager.model is not None
+    updates = manager.build_updates(
+        [KeyMessage(None, "3.0,-3.0,hi"), KeyMessage(None, "-3.0,3.0,lo")]
+    )
+    assert updates
+    for u in updates:
+        tree_id, node_id, counts = json.loads(u)
+        assert isinstance(tree_id, int)
+        assert node_id.startswith("r")
+        assert all(int(c) > 0 for c in counts.values())
+    # UP messages are ignored (its own updates)
+    manager.consume_key_message("UP", updates[0])
+
+
+def test_speed_manager_regression_update_format():
+    config = _reg_config({"oryx.rdf.num-trees": 2})
+    rand.use_test_seed()
+    update = RDFUpdate(config)
+    rng = np.random.default_rng(2)
+    lines = [
+        f"{a:.3f},{b:.3f},{a + b:.3f}" for a, b in rng.uniform(0, 5, size=(150, 2))
+    ]
+    pmml = update.build_model(
+        None, [KeyMessage(None, ln) for ln in lines], [16, 4, "variance"], None
+    )
+    manager = RDFSpeedModelManager(config)
+    manager.consume_key_message("MODEL", pmmlutils.to_string(pmml))
+    updates = manager.build_updates([KeyMessage(None, "1.0,1.0,2.0")])
+    assert len(updates) == 2  # one per tree
+    for u in updates:
+        tree_id, node_id, mean, count = json.loads(u)
+        assert mean == pytest.approx(2.0)
+        assert count == 1
+
+
+# ---------------------------------------------------------------------------
+# serving manager + endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_serving_manager_up_updates_leaf():
+    config = _cls_config()
+    manager = RDFServingModelManager(config)
+    manager.consume_key_message("MODEL", _published_model_message())
+    model = manager.get_model()
+    assert model.predict(["4.0", "-4.0", ""]) == "hi"
+    # find the terminal for that example and flip it via UP counts
+    ex = example_from_tokens(["4.0", "-4.0", ""], model.input_schema, model.encodings)
+    lo_enc = model.encodings.get_value_encoding_map(2)["lo"]
+    for tree_id, tree in enumerate(model.forest.trees):
+        node = tree.find_terminal(ex)
+        manager.consume_key_message(
+            "UP", json.dumps([tree_id, node.id, {str(lo_enc): 100000}])
+        )
+    assert model.predict(["4.0", "-4.0", ""]) == "lo"
+
+
+@pytest.fixture()
+def rdf_serving(tmp_path):
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = _cls_config(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.rdf.serving.RDFServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.classreg",
+        }
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    prod = tp.TopicProducerImpl("memory:", "OryxUpdate")
+    prod.send("MODEL", _published_model_message())
+    layer = ServingLayer(config)
+    layer.start()
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get("/ready").status_code == 200:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("serving layer never became ready")
+    yield client, config
+    client.close()
+    layer.close()
+    tp.reset_memory_brokers()
+
+
+def test_predict_endpoint(rdf_serving):
+    client, _ = rdf_serving
+    r = client.get("/predict/4.0,-4.0,")
+    assert r.status_code == 200
+    assert r.text.strip() == "hi"
+    r = client.post("/predict", content="4.0,-4.0,\n-4.0,4.0,")
+    assert r.status_code == 200
+    assert r.json() == ["hi", "lo"]
+
+
+def test_classification_distribution_endpoint(rdf_serving):
+    client, _ = rdf_serving
+    r = client.get("/classificationDistribution/4.0,-4.0,")
+    assert r.status_code == 200
+    result = r.json()
+    ids = {e["id"] for e in result}
+    assert ids == {"hi", "lo"}
+    total = sum(e["value"] for e in result)
+    assert total == pytest.approx(1.0)
+
+
+def test_feature_importance_endpoint(rdf_serving):
+    client, _ = rdf_serving
+    r = client.get("/feature/importance")
+    assert r.status_code == 200
+    values = r.json()
+    assert len(values) == 3  # one per feature (target importance 0)
+    r1 = client.get("/feature/importance/0")
+    assert r1.status_code == 200
+    assert float(r1.text) == pytest.approx(values[0])
+    assert client.get("/feature/importance/9").status_code == 400
+
+
+def test_train_endpoint_writes_input(rdf_serving):
+    client, _ = rdf_serving
+    r = client.post("/train/1.0,2.0,lo")
+    assert r.status_code == 204
+    r = client.post("/train", content="1.0,2.0,lo\n3.0,1.0,hi")
+    assert r.status_code == 204
+    broker = tp.get_broker("memory:")
+    msgs = broker.read("OryxInput", 0)
+    assert len(msgs) == 3
+
+
+def test_bad_datum_is_400(rdf_serving):
+    client, _ = rdf_serving
+    assert client.get("/predict/not-a-number,2.0,").status_code == 400
