@@ -1,0 +1,3 @@
+from oryx_tpu.cli.main import main
+
+raise SystemExit(main())

@@ -1,0 +1,145 @@
+"""oryx-run CLI: launch layers and manage topics from the command line.
+
+Equivalent of the reference's deploy tier (deploy/oryx-{batch,speed,serving}
+Main.java:30-37 and deploy/bin/oryx-run.sh:16-36): commands
+``batch | speed | serving | topic-setup | topic-tail | topic-input``. Each
+layer command constructs its layer from the (default-overlaid) config file,
+registers shutdown close, starts, and awaits termination; the topic commands
+mirror ``kafka-setup`` / ``kafka-tail`` / ``kafka-input``.
+
+Usage::
+
+    python -m oryx_tpu.cli batch --conf myapp.conf
+    python -m oryx_tpu.cli topic-tail --conf myapp.conf --which update
+    echo "a b c" | python -m oryx_tpu.cli topic-input --conf myapp.conf
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common.lockutils import close_at_shutdown
+from oryx_tpu.transport import topic as tp
+
+log = logging.getLogger(__name__)
+
+
+def _load_config(path: "str | None"):
+    if path:
+        return cfg.Config.parse_file(path).overlay_on(cfg.get_default())
+    return cfg.get_default()
+
+
+def _run_layer(layer_cls_path: str, config) -> int:
+    """Main.java pattern: construct, close-at-shutdown, start, await."""
+    module_name, cls_name = layer_cls_path.rsplit(".", 1)
+    import importlib
+
+    layer_cls = getattr(importlib.import_module(module_name), cls_name)
+    log.info("config:\n%s", config.pretty_print())
+    layer = layer_cls(config)
+    close_at_shutdown(layer)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    layer.start()
+    try:
+        layer.await_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        layer.close()
+    return 0
+
+
+def _topics(config) -> dict[str, tuple[str, str]]:
+    return {
+        "input": (
+            config.get_string("oryx.input-topic.broker"),
+            config.get_string("oryx.input-topic.message.topic"),
+        ),
+        "update": (
+            config.get_string("oryx.update-topic.broker"),
+            config.get_string("oryx.update-topic.message.topic"),
+        ),
+    }
+
+
+def cmd_topic_setup(config, args) -> int:
+    """Create both topics if absent (oryx-run.sh kafka-setup)."""
+    for which, (broker_url, name) in _topics(config).items():
+        broker = tp.get_broker(broker_url)
+        if broker.topic_exists(name):
+            print(f"{which}: topic {name} exists")
+        else:
+            broker.create_topic(name)
+            print(f"{which}: created topic {name}")
+    return 0
+
+
+def cmd_topic_tail(config, args) -> int:
+    """Stream a topic's messages to stdout (oryx-run.sh kafka-tail)."""
+    broker_url, name = _topics(config)[args.which]
+    broker = tp.get_broker(broker_url)
+    it = tp.ConsumeDataIterator(broker, name, "earliest")
+    try:
+        for km in it:
+            print(f"{km.key}\t{km.message}", flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        it.close()
+    return 0
+
+
+def cmd_topic_input(config, args) -> int:
+    """Feed stdin lines to the input topic (oryx-run.sh kafka-input)."""
+    broker_url, name = _topics(config)["input"]
+    producer = tp.TopicProducerImpl(broker_url, name)
+    n = 0
+    for line in sys.stdin:
+        line = line.rstrip("\n")
+        if line:
+            producer.send(None, line)
+            n += 1
+    producer.close()
+    print(f"sent {n} messages to {name}", file=sys.stderr)
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="oryx-run", description="Oryx TPU runner (oryx-run.sh equivalent)"
+    )
+    parser.add_argument("command", choices=[
+        "batch", "speed", "serving", "topic-setup", "topic-tail", "topic-input",
+    ])
+    parser.add_argument("--conf", help="HOCON config file overlaid on defaults")
+    parser.add_argument(
+        "--which", choices=["input", "update"], default="update",
+        help="which topic for topic-tail",
+    )
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    config = _load_config(args.conf)
+    if args.command == "batch":
+        return _run_layer("oryx_tpu.lambda_rt.batch.BatchLayer", config)
+    if args.command == "speed":
+        return _run_layer("oryx_tpu.lambda_rt.speed.SpeedLayer", config)
+    if args.command == "serving":
+        return _run_layer("oryx_tpu.serving.app.ServingLayer", config)
+    if args.command == "topic-setup":
+        return cmd_topic_setup(config, args)
+    if args.command == "topic-tail":
+        return cmd_topic_tail(config, args)
+    return cmd_topic_input(config, args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Word-count example app + CLI tests (mirrors reference example app tests
+and the oryx-run.sh command surface, SURVEY §2.12-2.13)."""
+
+import io
+import json
+import time
+
+import httpx
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.cli.main import main as cli_main
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils
+from oryx_tpu.example.wordcount import (
+    ExampleBatchLayerUpdate,
+    ExampleServingModelManager,
+    ExampleSpeedModelManager,
+    count_distinct_other_words,
+)
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    tp.reset_memory_brokers()
+    yield
+    tp.reset_memory_brokers()
+
+
+class _CapturingProducer:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, key, message):
+        self.sent.append((key, message))
+
+
+# ---------------------------------------------------------------------------
+# word-count logic (ExampleBatchLayerUpdateTest equivalent)
+# ---------------------------------------------------------------------------
+
+
+def test_count_distinct_other_words():
+    counts = count_distinct_other_words(["a b c", "a b", "d"])
+    assert counts == {"a": 2, "b": 2, "c": 2, "d": 0}
+
+
+def test_batch_update_publishes_model():
+    producer = _CapturingProducer()
+    ExampleBatchLayerUpdate().run_update(
+        None, 0,
+        [KeyMessage(None, "a b"), KeyMessage(None, "b c")],
+        [KeyMessage(None, "c d")],
+        None, producer,
+    )
+    assert len(producer.sent) == 1
+    key, message = producer.sent[0]
+    assert key == "MODEL"
+    assert json.loads(message) == {"a": 1, "b": 2, "c": 2, "d": 1}
+
+
+def test_speed_manager_approximate_counts():
+    manager = ExampleSpeedModelManager()
+    manager.consume_key_message("MODEL", json.dumps({"a": 5}))
+    updates = manager.build_updates([KeyMessage(None, "a b")])
+    # a was known with 5, gains 1 distinct co-word; b is new with 1
+    assert set(updates) == {"a,6", "b,1"}
+    manager.consume_key_message("UP", "ignored,1")
+
+
+def test_serving_manager_merges_model_and_ups():
+    config = cfg.get_default()
+    manager = ExampleServingModelManager(config)
+    assert manager.get_model() is None
+    manager.consume_key_message("MODEL", json.dumps({"a": 2}))
+    manager.consume_key_message("UP", "b,7")
+    words = manager.get_model().get_words()
+    assert words == {"a": 2, "b": 7}
+
+
+# ---------------------------------------------------------------------------
+# full word-count loop over HTTP (the tutorial path)
+# ---------------------------------------------------------------------------
+
+
+def test_wordcount_end_to_end():
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.batch.update-class":
+                "oryx_tpu.example.wordcount.ExampleBatchLayerUpdate",
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.example.wordcount.ExampleServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.example.resources",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    batch = BatchLayer(config)
+    batch.start(interval_sec=0.5)
+    serving = ServingLayer(config)
+    serving.start()
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30)
+    try:
+        assert client.post("/add/a b c").status_code == 204
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if client.get("/ready").status_code == 200:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("never ready")
+        # batch counted the ingested line
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            words = client.get("/distinct").json()
+            if words.get("a") == 2:
+                break
+            time.sleep(0.2)
+        assert client.get("/distinct").json() == {"a": 2, "b": 2, "c": 2}
+        assert client.get("/distinct/a").text.strip() == "2"
+        assert client.get("/distinct/zzz").status_code == 400
+    finally:
+        client.close()
+        serving.close()
+        batch.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI (oryx-run.sh command surface)
+# ---------------------------------------------------------------------------
+
+
+def _write_conf(tmp_path, extra: str = "") -> str:
+    conf = tmp_path / "app.conf"
+    conf.write_text(
+        f"""
+oryx {{
+  input-topic.broker = "file://{tmp_path}/topics"
+  update-topic.broker = "file://{tmp_path}/topics"
+{extra}
+}}
+"""
+    )
+    return str(conf)
+
+
+def test_cli_topic_setup_and_input(tmp_path, monkeypatch, capsys):
+    conf = _write_conf(tmp_path)
+    assert cli_main(["topic-setup", "--conf", conf]) == 0
+    out = capsys.readouterr().out
+    assert "created topic OryxInput" in out
+    assert "created topic OryxUpdate" in out
+    # idempotent
+    assert cli_main(["topic-setup", "--conf", conf]) == 0
+    assert "exists" in capsys.readouterr().out
+
+    monkeypatch.setattr("sys.stdin", io.StringIO("a b\nc d\n"))
+    assert cli_main(["topic-input", "--conf", conf]) == 0
+    broker = tp.get_broker(f"file://{tmp_path}/topics")
+    msgs = broker.read("OryxInput", 0)
+    assert [m.message for m in msgs] == ["a b", "c d"]
+
+
+def test_cli_rejects_unknown_command():
+    with pytest.raises(SystemExit):
+        cli_main(["frobnicate"])
+
+
+def test_example_confs_parse():
+    import pathlib
+
+    for path in pathlib.Path("conf").glob("*.conf"):
+        config = cfg.Config.parse_file(str(path)).overlay_on(cfg.get_default())
+        assert config.get_string("oryx.serving.model-manager-class")
+        assert config.get_int("oryx.serving.api.port") == 8080
