@@ -96,6 +96,15 @@ oryx = {
     no-init-topics = false
   }
 
+  # Per-step timing + optional jax.profiler traces (replaces the reference's
+  # Spark-UI observability; SURVEY §5.1).
+  tracing = {
+    enabled = false
+    profile-dir = null
+    profile-steps = 5
+    log-interval-sec = 60
+  }
+
   ml = {
     eval = {
       test-fraction = 0.1

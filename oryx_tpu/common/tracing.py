@@ -1,0 +1,110 @@
+"""Per-step timing + optional device profiling, behind config flags.
+
+TPU-native observability replacing the reference's Spark-UI-based story
+(SURVEY §5.1: ``oryx.batch.ui.port``/``oryx.speed.ui.port`` spark UIs,
+``spark.logConf=true`` — reference.conf:84-90,147-151): each layer wraps its
+generation/microbatch work in a ``StepTracer.step(...)`` that
+
+  * records wall time and item counts per step,
+  * logs a rate-limited one-line summary (mean/last duration, throughput),
+  * when ``oryx.tracing.profile-dir`` is set, captures a ``jax.profiler``
+    trace of the first ``profile-steps`` steps into that directory for
+    TensorBoard/XProf inspection.
+
+Tracing is off by default and costs one ``time.perf_counter`` pair per step
+when disabled.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+
+from oryx_tpu.common.lockutils import RateLimitCheck
+
+log = logging.getLogger(__name__)
+
+
+class StepTracer:
+    def __init__(self, config, tier: str):
+        self.tier = tier
+        self.enabled = config.get_bool("oryx.tracing.enabled", False)
+        self.profile_dir = config.get_string("oryx.tracing.profile-dir", None)
+        self.profile_steps = config.get_int("oryx.tracing.profile-steps", 5)
+        self._log_check = RateLimitCheck(
+            config.get_float("oryx.tracing.log-interval-sec", 60.0)
+        )
+        self.steps = 0
+        self.total_sec = 0.0
+        self.total_items = 0
+        self.last_sec = 0.0
+        self._profiling = False
+
+    @contextmanager
+    def step(self, name: str, n_items: int = 0):
+        """Time one generation/microbatch; no-op-cheap when disabled."""
+        if not self.enabled:
+            yield
+            return
+        profile = (
+            self.profile_dir is not None
+            and self.steps < self.profile_steps
+        )
+        if profile:
+            self._start_profiler()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.steps += 1
+            self.total_sec += dt
+            self.total_items += n_items
+            self.last_sec = dt
+            if profile and self.steps >= self.profile_steps:
+                self._stop_profiler()
+            if self._log_check.test():
+                mean = self.total_sec / max(self.steps, 1)
+                rate = self.total_items / self.total_sec if self.total_sec > 0 else 0.0
+                log.info(
+                    "[%s] %s: step %d took %.3fs (mean %.3fs, %d items, %.1f items/s cum)",
+                    self.tier, name, self.steps, dt, mean, n_items, rate,
+                )
+
+    def _start_profiler(self) -> None:
+        if self._profiling:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+            log.info("[%s] profiler trace started -> %s", self.tier, self.profile_dir)
+        except Exception:  # noqa: BLE001 - profiling must never kill a layer
+            log.exception("failed to start profiler trace")
+
+    def _stop_profiler(self) -> None:
+        if not self._profiling:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            log.info("[%s] profiler trace written -> %s", self.tier, self.profile_dir)
+        except Exception:  # noqa: BLE001
+            log.exception("failed to stop profiler trace")
+        finally:
+            self._profiling = False
+
+    def metrics(self) -> dict:
+        """Counters for health/introspection endpoints."""
+        return {
+            "steps": self.steps,
+            "total_sec": round(self.total_sec, 4),
+            "last_sec": round(self.last_sec, 4),
+            "total_items": self.total_items,
+        }
+
+    def close(self) -> None:
+        self._stop_profiler()

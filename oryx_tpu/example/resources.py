@@ -43,3 +43,12 @@ def register(app: web.Application) -> None:
     app.router.add_get("/distinct/{word}", distinct_word)
     app.router.add_post("/add/{line}", add_line)
     app.router.add_post("/add", add_body)
+
+    from oryx_tpu.serving.console import register_console
+
+    register_console(app, "Oryx word-count example", [
+        ("GET", "/distinct", "word → distinct co-word counts"),
+        ("GET", "/distinct/{word}", "one word's count"),
+        ("POST", "/add/{line}", "append a line of text"),
+        ("POST", "/add", "append lines from the body"),
+    ])

@@ -16,6 +16,7 @@ from typing import Callable, Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import classutils
+from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
 from oryx_tpu.transport import topic as tp
 
@@ -26,6 +27,7 @@ class AbstractLayer:
     def __init__(self, config, tier: str):
         self.config = config
         self.tier = tier
+        self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")
         self.input_topic = config.get_string("oryx.input-topic.message.topic")
@@ -107,7 +109,8 @@ class AbstractLayer:
                 batch.extend(km for km in chunk if km is not tp.CORRUPT_RECORD)
                 offset += len(chunk)
             timestamp_ms = int(time.time() * 1000)
-            on_batch(timestamp_ms, batch)
+            with self.tracer.step("generation", n_items=len(batch)):
+                on_batch(timestamp_ms, batch)
             self.store_input_offset(offset)
 
     # -- threads / lifecycle ------------------------------------------------
@@ -143,6 +146,7 @@ class AbstractLayer:
 
     def close(self) -> None:
         self._stop.set()
+        self.tracer.close()
         for t in self._threads:
             t.join(timeout=5)
 

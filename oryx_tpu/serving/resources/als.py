@@ -390,3 +390,27 @@ def register(app: web.Application) -> None:
     r.add_post("/pref/{userID}/{itemID}", set_preference)
     r.add_delete("/pref/{userID}/{itemID}", delete_preference)
     r.add_post("/ingest", ingest)
+
+    from oryx_tpu.serving.console import register_console
+
+    register_console(app, "Oryx ALS serving layer", [
+        ("GET", "/recommend/{userID}", "top-N recommendations for a user"),
+        ("GET", "/recommendToMany/{userID}/...", "recommendations for several users"),
+        ("GET", "/recommendToAnonymous/{itemID=value}/...", "recs from item interactions"),
+        ("GET", "/recommendWithContext/{userID}/{itemID}/...", "user recs blended with context items"),
+        ("GET", "/similarity/{itemID}/...", "items similar to items"),
+        ("GET", "/similarityToItem/{toItemID}/{itemID}/...", "pairwise similarities"),
+        ("GET", "/knownItems/{userID}", "items the user interacted with"),
+        ("GET", "/estimate/{userID}/{itemID}/...", "estimated strengths"),
+        ("GET", "/estimateForAnonymous/{toItemID}/{itemID=value}/...", "fold-in estimate"),
+        ("GET", "/because/{userID}/{itemID}", "known items explaining a rec"),
+        ("GET", "/mostSurprising/{userID}", "known items with lowest estimate"),
+        ("GET", "/popularRepresentativeItems", "one item per hash partition"),
+        ("GET", "/mostActiveUsers", "users with most known items"),
+        ("GET", "/mostPopularItems", "items known to most users"),
+        ("GET", "/user/allIDs", "all user IDs"),
+        ("GET", "/item/allIDs", "all item IDs"),
+        ("POST", "/pref/{userID}/{itemID}", "write a preference"),
+        ("DELETE", "/pref/{userID}/{itemID}", "delete a preference"),
+        ("POST", "/ingest", "bulk CSV ingest"),
+    ])

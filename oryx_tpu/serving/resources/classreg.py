@@ -102,3 +102,15 @@ def register(app: web.Application) -> None:
     app.router.add_route(
         "GET", "/feature/importance/{featureNumber}", feature_importance_one
     )
+
+    from oryx_tpu.serving.console import register_console
+
+    register_console(app, "Oryx classification/regression serving layer", [
+        ("GET", "/predict/{datum}", "forest vote for one datum"),
+        ("POST", "/predict", "forest votes, one per body line"),
+        ("POST", "/train/{datum}", "append one training example"),
+        ("POST", "/train", "append training examples from the body"),
+        ("GET", "/classificationDistribution/{datum}", "per-class probabilities"),
+        ("GET", "/feature/importance", "all feature importances"),
+        ("GET", "/feature/importance/{n}", "one feature's importance"),
+    ])

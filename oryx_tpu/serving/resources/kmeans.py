@@ -68,3 +68,13 @@ def register(app: web.Application) -> None:
     app.router.add_route("GET", "/distanceToNearest/{datum}", distance_to_nearest)
     app.router.add_route("POST", "/add/{datum}", add_datum)
     app.router.add_route("POST", "/add", add_body)
+
+    from oryx_tpu.serving.console import register_console
+
+    register_console(app, "Oryx clustering serving layer", [
+        ("GET", "/assign/{datum}", "nearest cluster ID for a datum"),
+        ("POST", "/assign", "nearest cluster IDs, one per body line"),
+        ("GET", "/distanceToNearest/{datum}", "distance to the closest center"),
+        ("POST", "/add/{datum}", "append a data point"),
+        ("POST", "/add", "append data points from the body"),
+    ])

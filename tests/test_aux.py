@@ -1,0 +1,66 @@
+"""Auxiliary subsystem tests: step tracing and serving consoles
+(SURVEY §5.1 observability, §2.11 consoles)."""
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common.tracing import StepTracer
+from oryx_tpu.serving.console import make_console
+
+
+def test_tracer_disabled_is_noop():
+    tracer = StepTracer(cfg.get_default(), "batch")
+    with tracer.step("generation", n_items=5):
+        pass
+    assert tracer.steps == 0
+    assert tracer.metrics()["steps"] == 0
+
+
+def test_tracer_enabled_records_steps():
+    config = cfg.overlay_on(
+        {"oryx.tracing.enabled": True, "oryx.tracing.log-interval-sec": 0.001},
+        cfg.get_default(),
+    )
+    tracer = StepTracer(config, "speed")
+    for _ in range(3):
+        with tracer.step("microbatch", n_items=10):
+            pass
+    m = tracer.metrics()
+    assert m["steps"] == 3
+    assert m["total_items"] == 30
+    assert m["total_sec"] >= 0
+    tracer.close()
+
+
+def test_tracer_survives_exceptions():
+    config = cfg.overlay_on({"oryx.tracing.enabled": True}, cfg.get_default())
+    tracer = StepTracer(config, "batch")
+    try:
+        with tracer.step("generation"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert tracer.steps == 1
+
+
+def test_console_renders_endpoints():
+    handler = make_console(
+        "Test console",
+        [("GET", "/foo/{id}", "does foo"), ("POST", "/bar", "does bar")],
+    )
+    response = asyncio.run(handler(None))
+    assert response.content_type == "text/html"
+    body = response.text
+    assert "Test console" in body
+    assert "/foo/{id}" in body
+    assert "does bar" in body
+
+
+def test_console_escapes_html():
+    handler = make_console("<script>x</script>", [("GET", "/a", "<b>bold</b>")])
+    body = asyncio.run(handler(None)).text
+    assert "<script>" not in body
+    assert "&lt;script&gt;" in body

@@ -1,0 +1,57 @@
+"""Gated serving load benchmark (reference LoadBenchmark.java:37-110 +
+LoadTestALSModelFactory: profile-gated there, env-gated here).
+
+Run with ``ORYX_BENCHMARK=1 python -m pytest tests/test_load_benchmark.py -s``.
+Knobs mirror the reference's ``-Doryx.test.als.benchmark.*`` properties via
+``ORYX_BENCH_{USERS,ITEMS,FEATURES,SAMPLE_RATE}``.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("ORYX_BENCHMARK") != "1",
+    reason="load benchmark is gated; set ORYX_BENCHMARK=1",
+)
+
+
+def test_als_recommend_load():
+    from oryx_tpu.models.als.serving import ALSServingModel
+
+    users = int(os.environ.get("ORYX_BENCH_USERS", "100000"))
+    items = int(os.environ.get("ORYX_BENCH_ITEMS", "200000"))
+    features = int(os.environ.get("ORYX_BENCH_FEATURES", "50"))
+    sample_rate = float(os.environ.get("ORYX_BENCH_SAMPLE_RATE", "1.0"))
+    how_many = 10
+    batch = 512
+
+    rng = np.random.default_rng(0)
+    model = ALSServingModel(features, implicit=True, sample_rate=sample_rate)
+    model.bulk_load_items(
+        [f"i{i}" for i in range(items)],
+        rng.standard_normal((items, features)).astype(np.float32),
+    )
+    queries = rng.standard_normal((users, features)).astype(np.float32)
+
+    _ = model.top_n_batch(queries[:batch], how_many)  # warm-up/compile
+
+    n_done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 3.0:
+        q = queries[n_done % users:][:batch]
+        if len(q) < batch:
+            q = queries[:batch]
+        results = model.top_n_batch(q, how_many)
+        assert len(results) == len(q)
+        n_done += len(q)
+    elapsed = time.perf_counter() - t0
+    qps = n_done / elapsed
+    ms_per_query = 1000.0 * elapsed / n_done
+    print(
+        f"\n[load] {items} items x {features}f sample={sample_rate}: "
+        f"{qps:,.0f} qps, {ms_per_query:.3f} ms/query (batched {batch})"
+    )
+    assert qps > 0
