@@ -1,0 +1,1 @@
+"""Operator tools (reference: app/oryx-app-serving traffic utilities)."""

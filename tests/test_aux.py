@@ -64,3 +64,46 @@ def test_console_escapes_html():
     body = asyncio.run(handler(None)).text
     assert "<script>" not in body
     assert "&lt;script&gt;" in body
+
+
+def test_traffic_runner_smoke():
+    """TrafficUtil equivalent drives HTTP load and aggregates outcomes."""
+    import threading
+    import time
+
+    from oryx_tpu.common import ioutils
+    from oryx_tpu.serving.app import ServingLayer
+    from oryx_tpu.tools.traffic import TrafficRunner, build_als_endpoints
+    from oryx_tpu.transport import topic as tp
+
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.example.wordcount.ExampleServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.example.resources",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    layer = ServingLayer(config)
+    layer.start()
+    runner = TrafficRunner(
+        [f"127.0.0.1:{port}"],
+        build_als_endpoints(10, 10),
+        interval_ms=0,
+        threads=2,
+        duration_sec=1.0,
+    )
+    t = threading.Thread(target=runner.run, daemon=True)
+    t.start()
+    time.sleep(1.2)
+    runner.stop()
+    t.join(timeout=10)
+    layer.close()
+    tp.reset_memory_brokers()
+    # word-count app doesn't serve ALS paths: everything counts as an outcome
+    assert runner.requests > 0
+    assert runner.client_errors + runner.server_errors + runner.exceptions <= runner.requests
