@@ -43,16 +43,25 @@ def _round_up_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def _score(qs, mat):
+    """(B, n) scores with f32 accumulation. ``mat`` may be bfloat16 (the MXU's
+    native input dtype — half the HBM traffic of f32); accumulation stays f32
+    via preferred_element_type, the standard TPU matmul recipe."""
+    return jnp.matmul(
+        qs.astype(mat.dtype), mat.T, preferred_element_type=jnp.float32
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_dot(mat, q, valid, k: int):
-    scores = mat @ q
+    scores = _score(q[None, :], mat)[0]
     scores = jnp.where(valid, scores, -jnp.inf)
     return jax.lax.top_k(scores, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_dot_batch(mat, qs, valid, k: int):
-    scores = qs @ mat.T  # (B, n) — one MXU matmul for the whole query batch
+    scores = _score(qs, mat)  # (B, n) — one MXU matmul for the whole batch
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
     # approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's
     # own approximation); exact on backends without the TPU op
@@ -61,7 +70,7 @@ def _top_k_dot_batch(mat, qs, valid, k: int):
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_dot_batch_masked(mat, qs, lut, buckets, k: int):
-    scores = qs @ mat.T  # (B, n)
+    scores = _score(qs, mat)  # (B, n)
     valid = jnp.take_along_axis(lut, buckets[None, :], axis=1)  # (B, n)
     scores = jnp.where(valid, scores, -jnp.inf)
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
@@ -80,16 +89,22 @@ class _YSnapshot:
 
     def __init__(self, ids: list[str], mat, lsh: LocalitySensitiveHash | None):
         self.ids = ids
-        self.mat = mat  # jax (n, k) or None
+        self.mat = mat  # jax (n, k) or None, float32
         self.id_to_idx = {s: i for i, s in enumerate(ids)}
         if mat is not None:
             self.norms = jnp.linalg.norm(mat, axis=1)
+            # scoring copy: bf16 on TPU halves HBM traffic per scan; exact
+            # dots/norms keep the f32 matrix
+            self.score_mat = (
+                mat.astype(jnp.bfloat16) if jax.default_backend() == "tpu" else mat
+            )
             host = np.asarray(mat)
             self.buckets = (
                 jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
             )
         else:
             self.norms = None
+            self.score_mat = None
             self.buckets = None
 
     @property
@@ -227,7 +242,7 @@ class ALSServingModel(ServingModel):
         want = how_many + offset
         k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
         while True:
-            vals, idx = _top_k_dot(snap.mat, q, valid, k)
+            vals, idx = _top_k_dot(snap.score_mat, q, valid, k)
             out = self._collect(snap, np.asarray(vals), np.asarray(idx), want, allowed, rescore)
             if len(out) >= want or k >= snap.n:
                 return out[offset:offset + how_many]
@@ -254,7 +269,7 @@ class ALSServingModel(ServingModel):
                 snap.n,
                 _round_up_pow2(max(2 * how_many, 64) if filtering else max(how_many, 16)),
             )
-            vals, idx = _top_k_dot_batch(snap.mat, qs, valid, k)
+            vals, idx = _top_k_dot_batch(snap.score_mat, qs, valid, k)
         else:
             # per-query LSH candidate masks: (B, num_buckets) lookup table
             # indexed by item bucket on device
@@ -263,7 +278,7 @@ class ALSServingModel(ServingModel):
                 lut[b, self.lsh.get_candidate_indices(q)] = True
             k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
             vals, idx = _top_k_dot_batch_masked(
-                snap.mat, qs, jnp.asarray(lut), snap.buckets, k
+                snap.score_mat, qs, jnp.asarray(lut), snap.buckets, k
             )
         vals, idx = np.asarray(vals), np.asarray(idx)
         if not filtering:
