@@ -99,6 +99,33 @@ def _kmeans_single_run(key, points, weights, k: int, iterations: int, init: str)
     return centers, counts, cost
 
 
+@functools.partial(jax.jit, static_argnames=("k", "init"))
+def _init_centers(key, points, k: int, init: str):
+    if init == INIT_RANDOM:
+        return _init_random(key, points, k)
+    return _init_plus_plus(key, points, k)
+
+
+def _kmeans_pallas_run(key, points, weights, k, iterations, init, interpret):
+    """One restart with the fused Pallas Lloyd kernel (ops/pallas_kernels):
+    distances, argmin, and sum/count/cost accumulation in one pass per sweep —
+    the (N, k) intermediates never touch HBM."""
+    from oryx_tpu.ops.pallas_kernels import kmeans_assign_accumulate
+
+    centers = _init_centers(key, points, k, init)
+    cost = jnp.float32(0)
+    for _ in range(iterations):
+        sums, counts, _ = kmeans_assign_accumulate(
+            points, weights, centers, interpret=interpret
+        )
+        new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+        centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+    sums, counts, cost = kmeans_assign_accumulate(
+        points, weights, centers, interpret=interpret
+    )
+    return centers, counts, cost
+
+
 def kmeans_train(
     points: np.ndarray,
     k: int,
@@ -106,11 +133,15 @@ def kmeans_train(
     runs: int = 1,
     init: str = INIT_KMEANS_PARALLEL,
     key=None,
+    use_pallas: "bool | None" = None,
+    interpret: bool = False,
 ):
     """Train on (N, d) points; returns (centers (k,d) np, counts (k,) np).
 
     ``runs`` restarts execute as one vmapped program; best-cost run wins
-    (MLlib KMeans ``runs`` semantics).
+    (MLlib KMeans ``runs`` semantics). On TPU (or with ``use_pallas=True``)
+    each Lloyd sweep instead runs the fused Pallas kernel, restarts
+    sequentially.
     """
     from oryx_tpu.common import rand
 
@@ -124,9 +155,20 @@ def kmeans_train(
     pts = jnp.asarray(points)
     weights = jnp.ones((n,), dtype=jnp.float32)
     keys = jax.random.split(key, max(runs, 1))
-    centers, counts, costs = jax.vmap(
-        lambda kk: _kmeans_single_run(kk, pts, weights, k, iterations, init)
-    )(keys)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        results = [
+            _kmeans_pallas_run(kk, pts, weights, k, iterations, init, interpret)
+            for kk in keys
+        ]
+        centers = jnp.stack([r[0] for r in results])
+        counts = jnp.stack([r[1] for r in results])
+        costs = jnp.stack([r[2] for r in results])
+    else:
+        centers, counts, costs = jax.vmap(
+            lambda kk: _kmeans_single_run(kk, pts, weights, k, iterations, init)
+        )(keys)
     best = int(jnp.argmin(costs))
     return (
         np.asarray(centers[best], dtype=np.float64),

@@ -1,0 +1,133 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+``kmeans_assign_accumulate`` fuses one full Lloyd-sweep accumulation —
+squared-distance evaluation, nearest-center argmin, and weighted
+sum/count/cost accumulation — into a single pass over point tiles. The
+unfused XLA formulation (models/kmeans/train.py lloyd step) materializes the
+(N, k) distance matrix and a second (N, k) one-hot indicator in HBM between
+ops; here both live only tile-at-a-time in VMEM:
+
+  grid = point tiles; per step:  d² tile = |p|² − 2 p·Cᵀ + |c|²   (MXU)
+                                 indicator = (d² == row-min)       (VPU)
+                                 sums   += indicatorᵀ · p          (MXU)
+                                 counts += Σ indicator, cost += Σ min d²
+
+Outputs revisit the same block every grid step (constant index map), the
+standard Pallas accumulation pattern: initialized at step 0 with ``pl.when``,
+accumulated thereafter. Off-TPU callers run the same kernel under
+``interpret=True`` (that is how the test suite exercises it on CPU).
+
+Tile sizes honor the f32 (8, 128) VMEM tiling: points tiles are
+(TILE_N, D_pad) with D and K padded to lane multiples by the wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_N = 512
+_LANE = 128
+
+
+def _pad_dim(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _kernel(points_ref, weights_ref, centers_ref, sums_ref, counts_ref, cost_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[:] = jnp.zeros_like(cost_ref)
+
+    p = points_ref[:]  # (T, D)
+    w = weights_ref[:]  # (T, 1); 0 marks padding rows
+    c = centers_ref[:]  # (K, D)
+
+    # squared distances, one MXU matmul per tile
+    p_sq = jnp.sum(p * p, axis=1, keepdims=True)  # (T, 1)
+    c_sq = jnp.sum(c * c, axis=1)[None, :]  # (1, K)
+    cross = jnp.dot(p, c.T, preferred_element_type=jnp.float32)  # (T, K)
+    d2 = jnp.maximum(p_sq - 2.0 * cross + c_sq, 0.0)
+
+    # nearest center as a one-hot indicator without host round trips;
+    # ties broken toward the lowest index like argmin
+    min_d2 = jnp.min(d2, axis=1, keepdims=True)  # (T, 1)
+    is_min = (d2 <= min_d2).astype(jnp.float32)
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, d2.shape, dimension=1)
+    first_min = jnp.min(
+        jnp.where(is_min > 0, k_ids, jnp.iinfo(jnp.int32).max), axis=1, keepdims=True
+    )
+    indicator = (k_ids == first_min).astype(jnp.float32) * w  # (T, K)
+
+    sums_ref[:] += jnp.dot(indicator.T, p, preferred_element_type=jnp.float32)
+    counts_ref[:] += jnp.sum(indicator, axis=0, keepdims=True)
+    cost_ref[:] += jnp.sum(min_d2 * w, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _call(points, weights, centers, *, interpret: bool):
+    n_pad, d_pad = points.shape
+    k_pad = centers.shape[0]
+    grid = (n_pad // TILE_N,)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_N, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_N, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(points, weights, centers)
+
+
+def kmeans_assign_accumulate(
+    points, weights, centers, *, interpret: "bool | None" = None
+):
+    """Fused Lloyd accumulation.
+
+    Args: points (N, D) f32, weights (N,) f32 (0 = padding), centers (K, D).
+    Returns (sums (K, D), counts (K,), cost scalar) as jax arrays.
+    """
+    points = jnp.asarray(points, dtype=jnp.float32)
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    centers = jnp.asarray(centers, dtype=jnp.float32)
+    n, d = points.shape
+    k = centers.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    n_pad = _pad_dim(max(n, 1), TILE_N)
+    d_pad = _pad_dim(d, _LANE)
+    k_pad = _pad_dim(k, 8)
+    pts = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(points)
+    # padding centers sit at +inf distance: give them huge coordinates is
+    # wrong (inf*0 NaN); instead pad with zeros and mask padded-k columns by
+    # adding a large constant to their distances via c_sq — achieved by
+    # placing padded centers far away on an unused axis
+    ctr = jnp.full((k_pad, d_pad), 0.0, jnp.float32).at[:k, :d].set(centers)
+    if k_pad > k:
+        ctr = ctr.at[k:, 0].set(3.4e38**0.5)  # pushes padded centers far away
+    wts = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights)
+
+    sums, counts, cost = _call(pts, wts, ctr, interpret=bool(interpret))
+    return sums[:k, :d], counts[0, :k], cost[0, 0]
