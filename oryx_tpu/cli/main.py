@@ -36,6 +36,9 @@ def _load_config(path: "str | None"):
 
 def _run_layer(layer_cls_path: str, config) -> int:
     """Main.java pattern: construct, close-at-shutdown, start, await."""
+    from oryx_tpu.parallel.distributed import initialize_from_config
+
+    initialize_from_config(config)
     module_name, cls_name = layer_cls_path.rsplit(".", 1)
     import importlib
 

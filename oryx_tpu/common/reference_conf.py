@@ -96,6 +96,15 @@ oryx = {
     no-init-topics = false
   }
 
+  # Multi-host job coordination via the JAX distributed runtime (replaces
+  # ZooKeeper/YARN process coordination; SURVEY §5.8). Single-host when
+  # coordinator is null.
+  distributed = {
+    coordinator = null
+    num-processes = null
+    process-id = null
+  }
+
   # Per-step timing + optional jax.profiler traces (replaces the reference's
   # Spark-UI observability; SURVEY §5.1).
   tracing = {

@@ -59,7 +59,8 @@ class ALSUpdate(MLUpdate):
         lam = float(hyper_parameters[1])
         alpha = float(hyper_parameters[2])
         epsilon = float(hyper_parameters[3]) if self.log_strength else 1.0e-5
-        assert features > 0 and lam >= 0.0 and alpha > 0.0
+        if features <= 0 or lam < 0.0 or alpha <= 0.0:
+            raise ValueError("features must be positive, lambda >= 0, alpha > 0")
 
         batch = als_data.prepare(
             (km.message for km in train_data),
@@ -71,6 +72,12 @@ class ALSUpdate(MLUpdate):
         )
         if batch.nnz == 0 or len(batch.users) == 0 or len(batch.items) == 0:
             return None
+        # factor/Gramian rows shard over the mesh's model axis when the batch
+        # tier runs multi-device (ComputeContext, SURVEY §2.14 block-ALS map)
+        mesh = row_axis = None
+        ctx_mesh = getattr(context, "mesh", None)
+        if ctx_mesh is not None and ctx_mesh.size > 1 and "model" in ctx_mesh.axis_names:
+            mesh, row_axis = ctx_mesh, "model"
         x, y = als_train_mod.als_train(
             batch,
             features=features,
@@ -79,6 +86,8 @@ class ALSUpdate(MLUpdate):
             implicit=self.implicit,
             iterations=self.iterations,
             key=rand.get_key(),
+            mesh=mesh,
+            row_axis=row_axis,
         )
         return pmml_codec.model_to_pmml(
             np.asarray(x),

@@ -1,0 +1,69 @@
+"""Multi-host bootstrap: the JAX distributed runtime as process coordination.
+
+TPU-native replacement for the reference's cluster plumbing (SURVEY §5.8:
+ZooKeeper coordinates Kafka consumers and Spark-on-YARN executors; here the
+JAX distributed runtime coordinates hosts, and XLA collectives over ICI/DCN
+replace Spark shuffle/broadcast). Configure with::
+
+    oryx.distributed {
+      coordinator = "host0:8476"   # null = single-host (default)
+      num-processes = 4            # total hosts in the job
+      process-id = 0               # this host's rank
+    }
+
+On TPU pods the three values can usually be auto-detected from the
+environment, in which case ``coordinator`` may be set with the other two left
+null. ``initialize_from_config`` is idempotent and a no-op when no
+coordinator is configured, so single-host deployments never pay for it; the
+CLI calls it before constructing any layer.
+
+After initialization, ``jax.devices()`` spans every host's chips and a
+``ComputeContext`` mesh built from it shards programs across the whole pod —
+the same code path as single-host, which is the point.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_from_config(config) -> bool:
+    """Join the multi-host job described by ``oryx.distributed.*``.
+
+    Returns True when the distributed runtime was (or already is)
+    initialized, False for single-host configs.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = config.get_string("oryx.distributed.coordinator", None)
+    if not coordinator:
+        return False
+    num_processes = config.get_int("oryx.distributed.num-processes", None)
+    process_id = config.get_int("oryx.distributed.process-id", None)
+
+    import jax
+
+    log.info(
+        "joining distributed job: coordinator=%s processes=%s rank=%s",
+        coordinator, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "distributed runtime up: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized

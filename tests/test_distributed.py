@@ -1,0 +1,16 @@
+"""Multi-host bootstrap config tests (SURVEY §5.8; single-host no-op path —
+actually joining a job needs multiple processes, exercised on real pods)."""
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.parallel import distributed
+
+
+def test_no_coordinator_is_single_host_noop():
+    assert distributed.initialize_from_config(cfg.get_default()) is False
+    assert distributed.is_initialized() is False
+
+
+def test_config_keys_exist():
+    config = cfg.get_default()
+    assert config.get_string("oryx.distributed.coordinator", None) is None
+    assert config.get_int("oryx.distributed.num-processes", None) is None

@@ -304,3 +304,37 @@ def test_kmeans_endpoints(tmp_path):
     finally:
         layer.close()
         tp.reset_memory_brokers()
+
+
+def test_kmeans_hyperparam_tuning(tmp_path):
+    """k chosen by grid search over a range, best eval wins
+    (KMeansHyperParamTuningIT equivalent)."""
+    from oryx_tpu.common import rand
+
+    rand.use_test_seed()
+    config = _config(
+        {
+            "oryx.kmeans.hyperparams.k": [2, 3],
+            "oryx.ml.eval.candidates": 2,
+            "oryx.ml.eval.hyperparam-search": "grid",
+            "oryx.ml.eval.test-fraction": 0.2,
+        }
+    )
+    update = KMeansUpdate(config)
+
+    sent = []
+
+    class _Prod:
+        def send(self, key, message):
+            sent.append((key, message))
+
+    data = [KeyMessage(None, f"{p[0]},{p[1]}") for p in _blobs(n_per=40)]
+    update.run_update(None, 1234, data, [], str(tmp_path / "model"), _Prod())
+    models = [m for k, m in sent if k in ("MODEL", "MODEL-REF")]
+    assert len(models) == 1
+    from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
+
+    pmml = read_pmml_from_update_key_message("MODEL", models[0])
+    clusters = pmml_codec.read(pmml)
+    # data has 3 blobs; silhouette should prefer k=3 over k=2
+    assert len(clusters) == 3
