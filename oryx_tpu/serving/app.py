@@ -31,10 +31,23 @@ log = logging.getLogger(__name__)
 DEFAULT_RESOURCES = ["oryx_tpu.serving.resources.common"]
 
 
+@web.middleware
+async def _compression_middleware(request, handler):
+    """Negotiated gzip/deflate response bodies (the reference registers
+    Jersey EncodingFilter+Gzip/DeflateEncoder, OryxApplication.java:88-93)."""
+    response = await handler(request)
+    try:
+        if response.body is not None and len(response.body) >= 512:
+            response.enable_compression()
+    except AttributeError:  # streaming/file responses
+        pass
+    return response
+
+
 def make_app(config, manager, input_producer=None) -> web.Application:
     """Build the aiohttp application with resources from config
     (OryxApplication.java:54-96)."""
-    middlewares = [rsrc.error_middleware]
+    middlewares = [rsrc.error_middleware, _compression_middleware]
     auth_mw = _basic_auth_middleware(config)
     if auth_mw is not None:
         middlewares.append(auth_mw)

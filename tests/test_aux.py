@@ -107,3 +107,43 @@ def test_traffic_runner_smoke():
     # word-count app doesn't serve ALS paths: everything counts as an outcome
     assert runner.requests > 0
     assert runner.client_errors + runner.server_errors + runner.exceptions <= runner.requests
+
+
+def test_compressed_responses():
+    """Large responses gzip when the client accepts it (CompressedResponseTest)."""
+    import json as _json
+
+    import httpx
+
+    from oryx_tpu.common import ioutils
+    from oryx_tpu.serving.app import ServingLayer
+    from oryx_tpu.transport import topic as tp
+
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.example.wordcount.ExampleServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.example.resources",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    prod = tp.TopicProducerImpl("memory:", "OryxUpdate")
+    prod.send("MODEL", _json.dumps({f"word{i}": i for i in range(500)}))
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+            r = client.get("/distinct", headers={"Accept-Encoding": "gzip"})
+            assert r.status_code == 200
+            assert r.headers.get("Content-Encoding") == "gzip"
+            assert r.json()["word7"] == 7  # httpx transparently decompresses
+            # small responses stay uncompressed
+            r2 = client.get("/distinct/word7", headers={"Accept-Encoding": "gzip"})
+            assert r2.headers.get("Content-Encoding") is None
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
