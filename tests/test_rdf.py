@@ -487,3 +487,43 @@ def test_train_endpoint_writes_input(rdf_serving):
 def test_bad_datum_is_400(rdf_serving):
     client, _ = rdf_serving
     assert client.get("/predict/not-a-number,2.0,").status_code == 400
+
+
+def test_rdf_categorical_predictor_end_to_end():
+    """Categorical predictor flows through training → SimpleSetPredicate PMML
+    → serving predictions (RDFPMMLUtilsTest categorical coverage)."""
+    rand.use_test_seed()
+    config = cfg.overlay_on(
+        {
+            "oryx.input-schema.feature-names": ["color", "x", "label"],
+            "oryx.input-schema.categorical-features": ["color", "label"],
+            "oryx.input-schema.target-feature": "label",
+            "oryx.rdf.num-trees": 1,
+            "oryx.ml.eval.test-fraction": 0.2,
+        },
+        cfg.get_default(),
+    )
+    update = RDFUpdate(config)
+    rng = np.random.default_rng(9)
+    colors = ["red", "green", "blue", "teal"]
+    lines = []
+    for _ in range(300):
+        c = colors[rng.integers(4)]
+        x = rng.uniform(0, 1)
+        label = "warm" if c in ("red", "teal") else "cool"
+        lines.append(f"{c},{x:.3f},{label}")
+    data = [KeyMessage(None, ln) for ln in lines]
+    pmml = update.build_model(None, data, [8, 4, "gini"], None)
+    assert pmml is not None
+    # the tree must split on the categorical color feature
+    xml = pmmlutils.to_string(pmml)
+    assert "SimpleSetPredicate" in xml
+    acc = update.evaluate(None, pmml, None, data[:50], data)
+    assert acc == 1.0  # perfectly determined by color
+
+    manager = RDFServingModelManager(config)
+    manager.consume_key_message("MODEL", pmmlutils.to_string(pmml))
+    model = manager.get_model()
+    assert model.predict(["red", "0.5", ""]) == "warm"
+    assert model.predict(["green", "0.5", ""]) == "cool"
+    assert model.predict(["teal", "0.1", ""]) == "warm"

@@ -248,3 +248,48 @@ def test_read_only_and_auth(tmp_path):
     finally:
         layer.close()
         tp.reset_memory_brokers()
+
+
+def test_tls_serving(tmp_path):
+    """HTTPS via keystore-file/key-alias config (SecureAPIConfigIT equivalent)."""
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.api.keystore-file": str(cert),
+            "oryx.serving.api.key-alias": str(key),
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.example.wordcount.ExampleServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.example.resources",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    tp.TopicProducerImpl("memory:", "OryxUpdate").send("MODEL", "{\"a\": 1}")
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        with httpx.Client(base_url=f"https://127.0.0.1:{port}", verify=False,
+                          timeout=30) as client:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if client.get("/ready").status_code == 200:
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail("TLS serving never ready")
+            assert client.get("/distinct").json() == {"a": 1}
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
