@@ -16,6 +16,8 @@ Prints exactly one JSON line:
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -32,7 +34,33 @@ BASELINE_QPS = 437.0  # BASELINE.md: 50 feat / 1M items / LSH 0.3 (their best)
 HOW_MANY = 10
 
 
+def _probe_default_backend(timeout_sec: int = 90) -> bool:
+    """True if the default JAX backend initializes in a fresh process.
+
+    Guards against a hung accelerator tunnel: backend init has no internal
+    timeout, so probe in a subprocess and fall back to CPU on failure rather
+    than hanging the benchmark forever."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_sec,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    if not _probe_default_backend():
+        print(
+            "default backend unreachable; falling back to CPU", file=sys.stderr
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     from oryx_tpu.common import rand
 
     rand.use_test_seed()
