@@ -113,7 +113,8 @@ class ExampleServingModelManager(AbstractServingModelManager):
                 self._words.update(model)
                 self._loaded = True
         elif key == "UP":
-            word, count = message.split(",")
+            # words may themselves contain commas; count is the last field
+            word, count = message.rsplit(",", 1)
             with self._lock:
                 self._words[word] = int(count)
                 self._loaded = True

@@ -109,20 +109,33 @@ def _init_centers(key, points, k: int, init: str):
 def _kmeans_pallas_run(key, points, weights, k, iterations, init, interpret):
     """One restart with the fused Pallas Lloyd kernel (ops/pallas_kernels):
     distances, argmin, and sum/count/cost accumulation in one pass per sweep —
-    the (N, k) intermediates never touch HBM."""
-    from oryx_tpu.ops.pallas_kernels import kmeans_assign_accumulate
+    the (N, k) intermediates never touch HBM. Points/weights are padded once
+    for the whole run; only the (small) centers re-pad per sweep."""
+    from oryx_tpu.ops import pallas_kernels as pk
 
     centers = _init_centers(key, points, k, init)
-    cost = jnp.float32(0)
-    for _ in range(iterations):
-        sums, counts, _ = kmeans_assign_accumulate(
-            points, weights, centers, interpret=interpret
+    n, d = points.shape
+    n_pad = pk._pad_dim(max(n, 1), pk.TILE_N)
+    d_pad = pk._pad_dim(d, pk._LANE)
+    k_pad = pk._pad_dim(k, 8)
+    pts = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(points)
+    wts = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights)
+
+    def pad_centers(c):
+        ctr = jnp.zeros((k_pad, d_pad), jnp.float32).at[:k, :d].set(c)
+        if k_pad > k:
+            ctr = ctr.at[k:, 0].set(pk.FAR_AWAY)
+        return ctr
+
+    counts = cost = None
+    for i in range(iterations + 1):
+        sums, counts_p, cost_p = pk._call(
+            pts, wts, pad_centers(centers), interpret=interpret
         )
-        new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
-        centers = jnp.where((counts > 0)[:, None], new_centers, centers)
-    sums, counts, cost = kmeans_assign_accumulate(
-        points, weights, centers, interpret=interpret
-    )
+        counts, cost = counts_p[0, :k], cost_p[0, 0]
+        if i < iterations:  # final sweep only reads counts/cost
+            new_centers = sums[:k, :d] / jnp.maximum(counts, 1.0)[:, None]
+            centers = jnp.where((counts > 0)[:, None], new_centers, centers)
     return centers, counts, cost
 
 

@@ -33,6 +33,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 512
 _LANE = 128
+# coordinate pushing padded centers beyond any real distance (squares to
+# ~f32-max without overflowing the distance expansion)
+FAR_AWAY = 3.4e38 ** 0.5
 
 
 def _pad_dim(n: int, multiple: int) -> int:
@@ -126,7 +129,7 @@ def kmeans_assign_accumulate(
     # placing padded centers far away on an unused axis
     ctr = jnp.full((k_pad, d_pad), 0.0, jnp.float32).at[:k, :d].set(centers)
     if k_pad > k:
-        ctr = ctr.at[k:, 0].set(3.4e38**0.5)  # pushes padded centers far away
+        ctr = ctr.at[k:, 0].set(FAR_AWAY)
     wts = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(weights)
 
     sums, counts, cost = _call(pts, wts, ctr, interpret=bool(interpret))

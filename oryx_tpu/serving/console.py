@@ -40,12 +40,6 @@ _ROW = (
     "<td>{form}</td></tr>"
 )
 
-_FORM = (
-    '<form action="{action}" method="get">'
-    '<input name="__path" placeholder="{placeholder}" size="24">'
-    '<button type="submit">GET</button></form>'
-)
-
 
 def make_console(title: str, endpoints: "list[tuple[str, str, str]]"):
     """Build the `/` handler from (method, path, description) rows."""

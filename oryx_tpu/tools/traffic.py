@@ -127,7 +127,11 @@ class TrafficRunner:
             w.start()
         try:
             while not self._stop.is_set():
-                self._stop.wait(60)
+                wait = 60.0
+                if self.duration_sec is not None:
+                    wait = min(wait, self.duration_sec - (time.monotonic() - start))
+                if wait > 0:
+                    self._stop.wait(wait)
                 self.report()
                 if self.duration_sec and time.monotonic() - start >= self.duration_sec:
                     break

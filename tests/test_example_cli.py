@@ -178,3 +178,11 @@ def test_example_confs_parse():
         config = cfg.Config.parse_file(str(path)).overlay_on(cfg.get_default())
         assert config.get_string("oryx.serving.model-manager-class")
         assert config.get_int("oryx.serving.api.port") == 8080
+
+
+def test_serving_manager_word_with_comma():
+    """UP words containing commas must not kill the consume thread."""
+    manager = ExampleServingModelManager(cfg.get_default())
+    manager.consume_key_message("MODEL", json.dumps({}))
+    manager.consume_key_message("UP", "foo,bar,7")
+    assert manager.get_model().get_words() == {"foo,bar": 7}
