@@ -94,6 +94,11 @@ oryx = {
     model-manager-class = null
     min-model-load-fraction = 0.8
     no-init-topics = false
+    # Shard the item-factor matrix over all local devices so Y can exceed
+    # one chip's memory; top-N becomes per-shard top-k + cross-shard merge.
+    compute = {
+      sharded = false
+    }
   }
 
   # Multi-host job coordination via the JAX distributed runtime (replaces

@@ -76,6 +76,39 @@ def _top_k_dot_batch_masked(mat, qs, lut, buckets, k: int):
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
+@functools.lru_cache(maxsize=8)
+def _sharded_top_k_fn(mesh, axis: str, k: int, n_real: int):
+    """Cross-shard top-N: Y's rows shard over ``axis``; each device scores
+    its block and takes a local top-k, then the (B, ndev·k) candidates merge
+    with one more top-k. This is the multi-chip scan of SURVEY §2.14
+    ("device-resident Y shards; top-N via sharded matmul + lax.top_k +
+    cross-shard merge") — the framework's intra-request parallelism."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(mat_blk, qs_blk):
+        n_local = mat_blk.shape[0]
+        offset = jax.lax.axis_index(axis) * n_local
+        scores = _score(qs_blk, mat_blk)  # (B, n_local)
+        col_ids = offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(col_ids < n_real, scores, -jnp.inf)
+        vals, idx = jax.lax.top_k(scores, k)
+        return vals, idx + offset
+
+    @jax.jit
+    def fn(mat, qs):
+        vals, idx = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(None, None)),
+            out_specs=(P(None, axis), P(None, axis)),
+        )(mat, qs)
+        mvals, pos = jax.lax.top_k(vals, k)  # merge (B, ndev*k) → (B, k)
+        return mvals, jnp.take_along_axis(idx, pos, axis=1)
+
+    return fn
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
     # mean cosine similarity to several query vectors (CosineAverageFunction.java)
@@ -85,12 +118,24 @@ def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
 
 
 class _YSnapshot:
-    """Immutable device view of Y: ids, matrix, norms, LSH buckets."""
+    """Immutable device view of Y: ids, matrix, norms, LSH buckets. With a
+    mesh, the scoring copy is row-sharded over ``shard_axis`` (rows padded to
+    the shard count) so Y may exceed a single device's memory."""
 
-    def __init__(self, ids: list[str], mat, lsh: LocalitySensitiveHash | None):
+    def __init__(
+        self,
+        ids: list[str],
+        mat,
+        lsh: LocalitySensitiveHash | None,
+        mesh=None,
+        shard_axis: str = "model",
+    ):
         self.ids = ids
         self.mat = mat  # jax (n, k) or None, float32
         self.id_to_idx = {s: i for i, s in enumerate(ids)}
+        self.mesh = mesh
+        self.shard_axis = shard_axis
+        self.sharded_mat = None
         if mat is not None:
             self.norms = jnp.linalg.norm(mat, axis=1)
             # scoring copy: bf16 on TPU halves HBM traffic per scan; exact
@@ -98,6 +143,21 @@ class _YSnapshot:
             self.score_mat = (
                 mat.astype(jnp.bfloat16) if jax.default_backend() == "tpu" else mat
             )
+            if mesh is not None:
+                n_shards = mesh.shape[shard_axis]
+                pad = (-mat.shape[0]) % n_shards
+                padded = (
+                    jnp.concatenate(
+                        [self.score_mat,
+                         jnp.zeros((pad, mat.shape[1]), self.score_mat.dtype)]
+                    )
+                    if pad
+                    else self.score_mat
+                )
+                sharding = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(shard_axis, None)
+                )
+                self.sharded_mat = jax.device_put(padded, sharding)
             host = np.asarray(mat)
             self.buckets = (
                 jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
@@ -113,10 +173,19 @@ class _YSnapshot:
 
 
 class ALSServingModel(ServingModel):
-    def __init__(self, features: int, implicit: bool, sample_rate: float = 1.0):
+    def __init__(
+        self,
+        features: int,
+        implicit: bool,
+        sample_rate: float = 1.0,
+        mesh=None,
+        shard_axis: str = "model",
+    ):
         self.features = features
         self.implicit = implicit
         self.sample_rate = sample_rate
+        self.mesh = mesh
+        self.shard_axis = shard_axis
         self.x = FeatureVectorStore()
         self.y = FeatureVectorStore()
         self.lsh = LocalitySensitiveHash(sample_rate, features) if sample_rate < 1.0 else None
@@ -219,7 +288,9 @@ class ALSServingModel(ServingModel):
         ids, mat = self.y.materialize()
         with self._snap_lock:
             if self._snapshot is None or self._snapshot_src is not mat:
-                self._snapshot = _YSnapshot(ids, mat, self.lsh)
+                self._snapshot = _YSnapshot(
+                    ids, mat, self.lsh, self.mesh, self.shard_axis
+                )
                 self._snapshot_src = mat
             return self._snapshot
 
@@ -263,6 +334,19 @@ class ALSServingModel(ServingModel):
         qs_host = np.asarray(query_vecs, dtype=np.float32)
         qs = jnp.asarray(qs_host)
         filtering = alloweds is not None and any(a is not None for a in alloweds)
+        if snap.sharded_mat is not None and not filtering and self.lsh is None:
+            # multi-device scan: per-shard top-k + cross-shard merge
+            n_local = snap.sharded_mat.shape[0] // snap.mesh.shape[snap.shard_axis]
+            k = min(how_many, n_local)
+            fn = _sharded_top_k_fn(snap.mesh, snap.shard_axis, k, snap.n)
+            vals, idx = fn(snap.sharded_mat, qs)
+            vals, idx = np.asarray(vals), np.asarray(idx)
+            ids = snap.ids
+            return [
+                [(ids[int(i)], float(v)) for v, i in zip(vals[b], idx[b])
+                 if np.isfinite(v)]
+                for b in range(len(query_vecs))
+            ]
         if self.lsh is None or snap.buckets is None:
             valid = jnp.ones(snap.n, dtype=bool)
             k = min(
@@ -394,6 +478,15 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.min_model_load_fraction = config.get_float("oryx.serving.min-model-load-fraction")
         self.model: ALSServingModel | None = None
         self.rescorer_provider = load_rescorer_providers(config)
+        self.mesh = None
+        if config.get_bool("oryx.serving.compute.sharded", False):
+            from oryx_tpu.parallel.mesh import make_mesh
+
+            if len(jax.devices()) > 1:
+                self.mesh = make_mesh(axes=("model",))
+                log.info("serving Y sharded over %d devices", self.mesh.size)
+            else:
+                log.info("sharded serving requested but only one device")
 
     def get_model(self) -> "ALSServingModel | None":
         return self.model
@@ -418,7 +511,9 @@ class ALSServingModelManager(AbstractServingModelManager):
             features = meta["features"]
             if self.model is None or self.model.features != features:
                 log.info("new serving model (features=%d)", features)
-                self.model = ALSServingModel(features, meta["implicit"], self.sample_rate)
+                self.model = ALSServingModel(
+                    features, meta["implicit"], self.sample_rate, mesh=self.mesh
+                )
                 self.model.expected_user_ids = set(meta["x_ids"])
                 self.model.expected_item_ids = set(meta["y_ids"])
             else:
