@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 
@@ -118,6 +119,7 @@ def main(argv: "list[str] | None" = None) -> int:
     )
     parser.add_argument("command", choices=[
         "batch", "speed", "serving", "topic-setup", "topic-tail", "topic-input",
+        "config-dump",
     ])
     parser.add_argument("--conf", help="HOCON config file overlaid on defaults")
     parser.add_argument(
@@ -130,6 +132,13 @@ def main(argv: "list[str] | None" = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    # honor JAX_PLATFORMS even when a site hook pre-imported jax and set the
+    # platform list programmatically (env alone is ignored in that case)
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        jax.config.update("jax_platforms", env_platforms)
     config = _load_config(args.conf)
     if args.command == "batch":
         return _run_layer("oryx_tpu.lambda_rt.batch.BatchLayer", config)
@@ -141,6 +150,12 @@ def main(argv: "list[str] | None" = None) -> int:
         return cmd_topic_setup(config, args)
     if args.command == "topic-tail":
         return cmd_topic_tail(config, args)
+    if args.command == "config-dump":
+        # resolved config as key=value properties (ConfigToProperties,
+        # settings/ConfigToProperties.java:60 / oryx-run.sh:88)
+        for key, value in sorted(config.to_properties().items()):
+            print(f"{key}={value}")
+        return 0
     return cmd_topic_input(config, args)
 
 

@@ -1,0 +1,102 @@
+"""Real multi-process deployment smoke: batch + serving layers launched as
+separate CLI processes over a file-backed broker, driven over HTTP — the
+oryx-run.sh usage pattern (SURVEY §2.13) end to end."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import httpx
+import pytest
+
+from oryx_tpu.common import ioutils
+
+
+def test_cli_multiprocess_wordcount(tmp_path):
+    port = ioutils.choose_free_port()
+    conf = tmp_path / "app.conf"
+    conf.write_text(f"""
+oryx {{
+  id = "cli-it"
+  input-topic.broker = "file://{tmp_path}/topics"
+  update-topic.broker = "file://{tmp_path}/topics"
+  batch {{
+    streaming.generation-interval-sec = 1
+    update-class = "oryx_tpu.example.wordcount.ExampleBatchLayerUpdate"
+    storage {{
+      data-dir = "{tmp_path}/data/"
+      model-dir = "{tmp_path}/model/"
+    }}
+  }}
+  serving {{
+    api.port = {port}
+    model-manager-class = "oryx_tpu.example.wordcount.ExampleServingModelManager"
+    application-resources = "oryx_tpu.example.resources"
+  }}
+}}
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+
+    def spawn(cmd):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "oryx_tpu.cli", cmd, "--conf", str(conf)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.getcwd(),
+        )
+        procs.append(p)
+        return p
+
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "oryx_tpu.cli", "topic-setup", "--conf", str(conf)],
+            env=env, check=True, capture_output=True, timeout=60,
+        )
+        spawn("batch")
+        spawn("serving")
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30) as client:
+            # wait for the HTTP surface to come up
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    client.get("/ready")
+                    break
+                except httpx.TransportError:
+                    time.sleep(0.5)
+            else:
+                pytest.fail("serving process never opened its port")
+            assert client.post("/add/a b c").status_code == 204
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (
+                    client.get("/ready").status_code == 200
+                    and client.get("/distinct").json().get("a") == 2
+                ):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail("model never flowed batch -> update topic -> serving")
+            assert client.get("/distinct").json() == {"a": 2, "b": 2, "c": 2}
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            assert p.wait(timeout=20) is not None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_cli_config_dump(tmp_path, capsys):
+    from oryx_tpu.cli.main import main as cli_main
+
+    conf = tmp_path / "app.conf"
+    conf.write_text('oryx.id = "dump-test"\n')
+    assert cli_main(["config-dump", "--conf", str(conf)]) == 0
+    out = capsys.readouterr().out
+    assert "oryx.id=dump-test" in out
+    assert "oryx.serving.api.port=8080" in out
