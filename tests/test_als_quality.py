@@ -1,0 +1,68 @@
+"""ALS quality at MovieLens-100K-like scale (SURVEY §7 milestone: "MovieLens
+100K ingest → train → fold-in → /recommend parity"). Gated behind
+ORYX_SLOW=1 to keep the default suite fast."""
+
+import os
+
+import numpy as np
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import rand
+from oryx_tpu.models.als.update import ALSUpdate
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("ORYX_SLOW") != "1",
+    reason="slow quality test; set ORYX_SLOW=1",
+)
+
+
+def _synthetic_movielens(n_users=900, n_items=1600, n_ratings=100_000, rank=5, seed=0):
+    """Low-rank preference structure with popularity skew, timestamped."""
+    rng = np.random.default_rng(seed)
+    u_f = rng.standard_normal((n_users, rank))
+    i_f = rng.standard_normal((n_items, rank))
+    scores = u_f @ i_f.T  # (U, I)
+    thresholds = np.quantile(scores, 0.75, axis=1)  # per-user affinity cut
+    # popularity skew: power law over item ranks (shuffled across item ids)
+    pop = rng.permutation(np.arange(1, n_items + 1, dtype=np.float64) ** -0.8)
+    pop /= pop.sum()
+    lines = []
+    seen = set()
+    users = rng.integers(0, n_users, size=n_ratings * 8)
+    items = rng.choice(n_items, p=pop, size=n_ratings * 8)
+    accept = rng.random(n_ratings * 8)
+    for u, i, a in zip(users, items, accept):
+        if len(lines) >= n_ratings:
+            break
+        if (u, i) in seen:
+            continue
+        # interact almost only with high-affinity items
+        if scores[u, i] < thresholds[u] and a < 0.95:
+            continue
+        seen.add((u, i))
+        lines.append(f"u{u},i{i},1,{len(lines)}")
+    return lines
+
+
+def test_als_auc_at_movielens_scale(tmp_path):
+    rand.use_test_seed()
+    config = cfg.overlay_on(
+        {
+            "oryx.als.iterations": 8,
+            "oryx.als.hyperparams.features": 20,
+            "oryx.als.hyperparams.lambda": 0.01,
+            "oryx.ml.eval.test-fraction": 0.1,
+        },
+        cfg.get_default(),
+    )
+    update = ALSUpdate(config)
+    lines = _synthetic_movielens()
+    data = [KeyMessage(None, ln) for ln in lines]
+    train, test = update.split_new_data_to_train_test(data)
+    pmml = update.build_model(None, train, [20, 0.01, 1.0], tmp_path)
+    assert pmml is not None
+    auc = update.evaluate(None, pmml, tmp_path, test, train)
+    # mean AUC well above chance on structured preferences
+    assert auc > 0.75, f"AUC too low: {auc}"
