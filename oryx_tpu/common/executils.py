@@ -50,3 +50,12 @@ def _logging_call(fn: Callable[[int], T], i: int) -> T:
 def map_in_parallel(items: Iterable[T], fn: Callable[[T], "T"], parallelism: int = 4) -> Iterator:
     with cf.ThreadPoolExecutor(max_workers=parallelism) as pool:
         yield from pool.map(fn, items)
+
+
+def get_used_memory() -> int:
+    """Resident-set bytes of this process (JVMUtils.getUsedMemory:53
+    equivalent — there heap-after-GC, here RSS from the OS)."""
+    import resource
+
+    # ru_maxrss is KiB on Linux
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024

@@ -131,11 +131,28 @@ class MLUpdate(BatchLayerUpdate):
 
     # -- candidate search (findBestCandidatePath:250-292) --------------------
     def _find_best_candidate_path(self, context, train, test, combos, scratch: Path):
+        # candidate-model parallelism (SURVEY §2.14 EP-like fan-out): with
+        # several devices and several candidates, round-robin each candidate's
+        # default device so parallel builds land on different chips
+        devices = None
+        if self.eval_parallelism > 1 and len(combos) > 1:
+            import jax
+
+            local = jax.local_devices()
+            if len(local) > 1:
+                devices = local
+
         def build_and_eval(i: int):
             candidate_path = scratch / f"{i}"
             candidate_path.mkdir(parents=True, exist_ok=True)
             try:
-                pmml = self.build_model(context, train, combos[i], candidate_path)
+                if devices is not None:
+                    import jax
+
+                    with jax.default_device(devices[i % len(devices)]):
+                        pmml = self.build_model(context, train, combos[i], candidate_path)
+                else:
+                    pmml = self.build_model(context, train, combos[i], candidate_path)
             except Exception:  # noqa: BLE001 - a failed candidate is skipped
                 log.exception("candidate %d failed to build", i)
                 return None

@@ -50,8 +50,11 @@ def test_als_recommend_load():
     elapsed = time.perf_counter() - t0
     qps = n_done / elapsed
     ms_per_query = 1000.0 * elapsed / n_done
+    from oryx_tpu.common.executils import get_used_memory
+
     print(
         f"\n[load] {items} items x {features}f sample={sample_rate}: "
-        f"{qps:,.0f} qps, {ms_per_query:.3f} ms/query (batched {batch})"
+        f"{qps:,.0f} qps, {ms_per_query:.3f} ms/query (batched {batch}), "
+        f"rss {get_used_memory() // (1 << 20)} MiB"
     )
     assert qps > 0
