@@ -295,3 +295,23 @@ def test_serving_manager_model_swap_retains(tmp_path):
     (tmp_path / "second").mkdir()
     _publish_model([mgr], tmp_path / "second")
     assert mgr.get_model() is model1
+
+
+def test_time_ordered_train_test_split():
+    """ALS holds out the LATEST data by timestamp, not a random sample
+    (ALSUpdate.splitNewDataToTrainTest:326-343)."""
+    from oryx_tpu.models.als.update import ALSUpdate
+
+    config = cfg.overlay_on(
+        {"oryx.ml.eval.test-fraction": 0.25}, cfg.get_default()
+    )
+    update = ALSUpdate(config)
+    data = [
+        KeyMessage(None, f"u{i},i{i},1,{ts}")
+        for i, ts in enumerate([50, 10, 40, 30, 20, 80, 60, 70])
+    ]
+    train, test = update.split_new_data_to_train_test(data)
+    train_ts = [int(km.message.split(",")[3]) for km in train]
+    test_ts = [int(km.message.split(",")[3]) for km in test]
+    assert len(test) == 2
+    assert max(train_ts) < min(test_ts)

@@ -1,0 +1,107 @@
+"""Rescorer SPI tests (mirrors reference MultiRescorerTest /
+MultiRescorerProviderTest and the RecommendTest rescorer coverage)."""
+
+import math
+
+import numpy as np
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.models.als.rescorer import (
+    MultiRescorer,
+    Rescorer,
+    RescorerProvider,
+    load_rescorer_providers,
+)
+from oryx_tpu.models.als.serving import ALSServingModel
+
+
+class _PlusOne(Rescorer):
+    def rescore(self, id_, score):
+        return score + 1.0
+
+
+class _FilterEven(Rescorer):
+    def rescore(self, id_, score):
+        return float("nan") if int(id_[1:]) % 2 == 0 else score
+
+
+class BanEvenProvider(RescorerProvider):
+    """Filters even-numbered item IDs; loadable by dotted name from config."""
+
+    def __init__(self, config=None):
+        pass
+
+    def get_recommend_rescorer(self, user_ids, args):
+        if args and args[0] == "off":
+            return None
+        return _FilterEven()
+
+
+class PlusOneProvider(RescorerProvider):
+    def __init__(self, config=None):
+        pass
+
+    def get_recommend_rescorer(self, user_ids, args):
+        return _PlusOne()
+
+
+def test_multi_rescorer_composes_and_filters():
+    multi = MultiRescorer([_PlusOne(), _PlusOne()])
+    assert multi.rescore("i1", 1.0) == 3.0
+    assert not multi.is_filtered("i1")
+    multi2 = MultiRescorer([_PlusOne(), _FilterEven()])
+    assert multi2.is_filtered("i2")
+    assert not multi2.is_filtered("i3")
+    assert math.isnan(multi2.rescore("i4", 9.0))
+
+
+def test_multi_rescorer_of_collapses():
+    assert MultiRescorer.of([None, None]) is None
+    single = _PlusOne()
+    assert MultiRescorer.of([None, single]) is single
+    assert isinstance(MultiRescorer.of([_PlusOne(), _PlusOne()]), MultiRescorer)
+
+
+def test_load_single_and_multiple_providers():
+    config = cfg.overlay_on(
+        {"oryx.als.rescorer-provider-class": "test_rescorer.BanEvenProvider"},
+        cfg.get_default(),
+    )
+    provider = load_rescorer_providers(config)
+    assert isinstance(provider, BanEvenProvider)
+    config2 = cfg.overlay_on(
+        {
+            "oryx.als.rescorer-provider-class":
+                "test_rescorer.BanEvenProvider,test_rescorer.PlusOneProvider"
+        },
+        cfg.get_default(),
+    )
+    multi = load_rescorer_providers(config2)
+    rescorer = multi.get_recommend_rescorer(["u0"], [])
+    assert rescorer.is_filtered("i2")
+    assert rescorer.rescore("i3", 1.0) == 2.0
+    assert load_rescorer_providers(cfg.get_default()) is None
+
+
+def test_rescorer_applies_to_top_n():
+    """Model-level: the rescore hook reorders and filters top-N results the
+    way the /recommend endpoint wires it."""
+    rng = np.random.default_rng(0)
+    model = ALSServingModel(8, implicit=True)
+    model.bulk_load_items(
+        [f"i{i}" for i in range(50)], rng.standard_normal((50, 8)).astype(np.float32)
+    )
+    q = rng.standard_normal(8).astype(np.float32)
+    rescorer = _FilterEven()
+    plain = model.top_n(q, 10)
+    filtered = model.top_n(
+        q, 10,
+        allowed=lambda i: not rescorer.is_filtered(i),
+        rescore=rescorer.rescore,
+    )
+    assert len(filtered) == 10
+    assert all(int(i[1:]) % 2 == 1 for i, _ in filtered)
+    plain_odd = [i for i, _ in plain if int(i[1:]) % 2 == 1]
+    assert [i for i, _ in filtered[: len(plain_odd)]] != [] and set(plain_odd) <= {
+        i for i, _ in filtered
+    } | {i for i, _ in plain}
