@@ -53,9 +53,17 @@ def map_in_parallel(items: Iterable[T], fn: Callable[[T], "T"], parallelism: int
 
 
 def get_used_memory() -> int:
-    """Resident-set bytes of this process (JVMUtils.getUsedMemory:53
-    equivalent — there heap-after-GC, here RSS from the OS)."""
+    """CURRENT resident-set bytes of this process (JVMUtils.getUsedMemory:53
+    equivalent — there heap-after-GC, here RSS from the OS). Reads VmRSS so
+    long-lived layers report a figure that can go down, not peak RSS."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024  # kB
+    except OSError:
+        pass
     import resource
 
-    # ru_maxrss is KiB on Linux
+    # fallback (non-Linux): peak RSS; ru_maxrss is KiB on Linux
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024

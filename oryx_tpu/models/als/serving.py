@@ -59,51 +59,81 @@ def _top_k_dot(mat, q, valid, k: int):
     return jax.lax.top_k(scores, k)
 
 
+def _mask_excluded(scores, excl):
+    """Per-query exclusion scatter: ``excl`` is (B, E) row indices, -1-padded.
+    Out-of-range entries are remapped to n (a drop index): negative scatter
+    indices would WRAP from the end, so they must be clamped explicitly."""
+    n = scores.shape[1]
+    excl = jnp.where((excl >= 0) & (excl < n), excl, n)
+    return jax.vmap(lambda row, ix: row.at[ix].set(-jnp.inf, mode="drop"))(
+        scores, excl
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
-def _top_k_dot_batch(mat, qs, valid, k: int):
+def _top_k_dot_batch(mat, qs, valid, excl, k: int):
     scores = _score(qs, mat)  # (B, n) — one MXU matmul for the whole batch
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    scores = _mask_excluded(scores, excl)
     # approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's
     # own approximation); exact on backends without the TPU op
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _top_k_dot_batch_masked(mat, qs, lut, buckets, k: int):
+def _top_k_dot_batch_masked(mat, qs, lut, buckets, excl, k: int):
     scores = _score(qs, mat)  # (B, n)
     valid = jnp.take_along_axis(lut, buckets[None, :], axis=1)  # (B, n)
     scores = jnp.where(valid, scores, -jnp.inf)
+    scores = _mask_excluded(scores, excl)
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
-@functools.lru_cache(maxsize=8)
-def _sharded_top_k_fn(mesh, axis: str, k: int, n_real: int):
+@functools.lru_cache(maxsize=32)
+def _sharded_top_k_fn(mesh, axis: str, k: int, k_final: int, n_real: int, use_lut: bool):
     """Cross-shard top-N: Y's rows shard over ``axis``; each device scores
-    its block and takes a local top-k, then the (B, ndev·k) candidates merge
-    with one more top-k. This is the multi-chip scan of SURVEY §2.14
-    ("device-resident Y shards; top-N via sharded matmul + lax.top_k +
-    cross-shard merge") — the framework's intra-request parallelism."""
+    its block, masks (pad rows, per-query LSH lut, per-query excluded items)
+    and takes a local top-k; the (B, ndev·k) candidates merge with one more
+    top-k. This is the multi-chip scan of SURVEY §2.14 ("device-resident Y
+    shards; top-N via sharded matmul + lax.top_k + cross-shard merge") — the
+    framework's intra-request parallelism.
+
+    Exclusion (known-item filtering, Recommend.java:84-106) is a device-side
+    scatter: ``excl`` is (B, E) GLOBAL row indices, -1-padded; each shard
+    rebases to local coordinates and drops out-of-range entries, so the mask
+    costs O(E) scatter per shard instead of a host round-trip."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def local(mat_blk, qs_blk):
+    def local(mat_blk, qs_blk, excl_blk, lut_blk, buckets_blk):
         n_local = mat_blk.shape[0]
         offset = jax.lax.axis_index(axis) * n_local
         scores = _score(qs_blk, mat_blk)  # (B, n_local)
         col_ids = offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         scores = jnp.where(col_ids < n_real, scores, -jnp.inf)
+        if use_lut:
+            valid = jnp.take_along_axis(
+                lut_blk, buckets_blk[None, :].astype(jnp.int32), axis=1
+            )
+            scores = jnp.where(valid, scores, -jnp.inf)
+        # per-query exclusions: global→local rebase; -1 pads and rows owned
+        # by other shards are remapped to the drop index (negative scatter
+        # indices would wrap, so clamp explicitly)
+        local_excl = excl_blk - offset
+        scores = _mask_excluded(scores, local_excl)
         vals, idx = jax.lax.top_k(scores, k)
         return vals, idx + offset
 
     @jax.jit
-    def fn(mat, qs):
+    def fn(mat, qs, excl, lut, buckets):
         vals, idx = shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis, None), P(None, None)),
+            in_specs=(P(axis, None), P(None, None), P(None, None),
+                      P(None, None), P(axis)),
             out_specs=(P(None, axis), P(None, axis)),
-        )(mat, qs)
-        mvals, pos = jax.lax.top_k(vals, k)  # merge (B, ndev*k) → (B, k)
+        )(mat, qs, excl, lut, buckets)
+        mvals, pos = jax.lax.top_k(vals, k_final)  # (B, ndev*k) → (B, k_final)
         return mvals, jnp.take_along_axis(idx, pos, axis=1)
 
     return fn
@@ -136,12 +166,17 @@ class _YSnapshot:
         self.mesh = mesh
         self.shard_axis = shard_axis
         self.sharded_mat = None
+        self.sharded_buckets = None
         if mat is not None:
             self.norms = jnp.linalg.norm(mat, axis=1)
             # scoring copy: bf16 on TPU halves HBM traffic per scan; exact
             # dots/norms keep the f32 matrix
             self.score_mat = (
                 mat.astype(jnp.bfloat16) if jax.default_backend() == "tpu" else mat
+            )
+            host = np.asarray(mat)
+            self.buckets = (
+                jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
             )
             if mesh is not None:
                 n_shards = mesh.shape[shard_axis]
@@ -158,10 +193,19 @@ class _YSnapshot:
                     mesh, jax.sharding.PartitionSpec(shard_axis, None)
                 )
                 self.sharded_mat = jax.device_put(padded, sharding)
-            host = np.asarray(mat)
-            self.buckets = (
-                jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
-            )
+                # bucket array rides the same sharding (zeros when no LSH so
+                # the shard_map signature stays fixed)
+                b = (
+                    np.asarray(self.buckets, dtype=np.int32)
+                    if self.buckets is not None
+                    else np.zeros(mat.shape[0], dtype=np.int32)
+                )
+                if pad:
+                    b = np.concatenate([b, np.zeros(pad, dtype=np.int32)])
+                bshard = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(shard_axis)
+                )
+                self.sharded_buckets = jax.device_put(b, bshard)
         else:
             self.norms = None
             self.score_mat = None
@@ -295,6 +339,57 @@ class ALSServingModel(ServingModel):
             return self._snapshot
 
     # -- query primitives ----------------------------------------------------
+    @staticmethod
+    def _excluded_indices(snap: _YSnapshot, excluded, batch: int) -> np.ndarray:
+        """(B, E) int32 of global Y rows to mask out, -1-padded, E a pow2 so
+        jit signatures stay stable across requests."""
+        idx_lists: list[list[int]] = []
+        max_e = 1
+        for b in range(batch):
+            ids = excluded[b] if excluded is not None else None
+            ix = (
+                [snap.id_to_idx[i] for i in ids if i in snap.id_to_idx]
+                if ids
+                else []
+            )
+            idx_lists.append(ix)
+            max_e = max(max_e, len(ix))
+        out = np.full((batch, _round_up_pow2(max_e)), -1, dtype=np.int32)
+        for b, ix in enumerate(idx_lists):
+            out[b, : len(ix)] = ix
+        return out
+
+    def _build_lut(self, qs_host: np.ndarray) -> np.ndarray:
+        """(B, num_buckets) bool LSH candidate lookup table, one row per query."""
+        lut = np.zeros((len(qs_host), self.lsh.num_buckets), dtype=bool)
+        for b, q in enumerate(qs_host):
+            lut[b, self.lsh.get_candidate_indices(q)] = True
+        return lut
+
+    def _sharded_query(self, snap: _YSnapshot, qs_host: np.ndarray, want: int, excluded):
+        """Multi-device scan: per-shard matmul + local top-k + cross-shard
+        merge, with LSH lut and per-query known-item exclusion applied
+        device-side (no host fallback for filtered traffic)."""
+        B = qs_host.shape[0]
+        ndev = snap.mesh.shape[snap.shard_axis]
+        n_local = snap.sharded_mat.shape[0] // ndev
+        want = min(want, snap.n)
+        k = min(n_local, _round_up_pow2(max(want, 16)))
+        k_final = min(ndev * k, _round_up_pow2(max(want, 16)))
+        use_lut = self.lsh is not None and snap.buckets is not None
+        lut_j = (
+            jnp.asarray(self._build_lut(qs_host))
+            if use_lut
+            else jnp.zeros((B, 1), dtype=bool)
+        )
+        excl = jnp.asarray(self._excluded_indices(snap, excluded, B))
+        fn = _sharded_top_k_fn(
+            snap.mesh, snap.shard_axis, k, k_final, snap.n, use_lut
+        )
+        vals, idx = fn(snap.sharded_mat, jnp.asarray(qs_host), excl, lut_j,
+                       snap.sharded_buckets)
+        return np.asarray(vals), np.asarray(idx)
+
     def top_n(
         self,
         query_vec: np.ndarray,
@@ -302,15 +397,33 @@ class ALSServingModel(ServingModel):
         offset: int = 0,
         allowed: "Callable[[str], bool] | None" = None,
         rescore: "Callable[[str, float], float] | None" = None,
+        excluded: "Sequence[str] | None" = None,
     ) -> list[tuple[str, float]]:
         """Dot-product top-N over Y: one matmul + top_k (ALSServingModel.topN
-        :261-276, TopNConsumer:56-73), then host-side filter/rescore/merge."""
+        :261-276, TopNConsumer:56-73). ``excluded`` ids (known-item filtering)
+        are masked on device; ``allowed``/``rescore`` host hooks (rescorer SPI)
+        filter the candidate stream with widening retry."""
         snap = self.y_snapshot()
         if snap.mat is None or snap.n == 0:
             return []
-        q = jnp.asarray(np.asarray(query_vec, dtype=np.float32))
-        valid = self._candidate_mask(snap, np.asarray(query_vec, dtype=np.float32))
+        q_host = np.asarray(query_vec, dtype=np.float32)
         want = how_many + offset
+        if snap.sharded_mat is not None:
+            k = want if allowed is None and rescore is None else max(4 * want, 64)
+            while True:
+                vals, idx = self._sharded_query(
+                    snap, q_host[None, :], k, [excluded] if excluded else None
+                )
+                out = self._collect(snap, vals[0], idx[0], want, allowed, rescore)
+                if len(out) >= want or k >= snap.n:
+                    return out[offset:offset + how_many]
+                k = min(snap.n, k * 2)  # widen: host filter consumed candidates
+        q = jnp.asarray(q_host)
+        valid = self._candidate_mask(snap, q_host)
+        if excluded:
+            ix = [snap.id_to_idx[i] for i in excluded if i in snap.id_to_idx]
+            if ix:
+                valid = valid.at[jnp.asarray(ix, dtype=jnp.int32)].set(False)
         k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
         while True:
             vals, idx = _top_k_dot(snap.score_mat, q, valid, k)
@@ -324,45 +437,43 @@ class ALSServingModel(ServingModel):
         query_vecs: np.ndarray,
         how_many: int,
         alloweds: "Sequence[Callable[[str], bool] | None] | None" = None,
+        excluded: "Sequence[Sequence[str] | None] | None" = None,
     ) -> list[list[tuple[str, float]]]:
         """Micro-batched top-N: many queries in ONE matmul+top_k device call —
         the TPU-idiomatic serving pattern (amortizes per-call overhead that the
-        reference spends thread-fanning partition scans)."""
+        reference spends thread-fanning partition scans). ``excluded[b]`` ids
+        are masked device-side; ``alloweds`` host callables (rescorer SPI)
+        filter after the scan."""
         snap = self.y_snapshot()
         if snap.mat is None or snap.n == 0:
             return [[] for _ in range(len(query_vecs))]
         qs_host = np.asarray(query_vecs, dtype=np.float32)
-        qs = jnp.asarray(qs_host)
         filtering = alloweds is not None and any(a is not None for a in alloweds)
-        if snap.sharded_mat is not None and not filtering and self.lsh is None:
-            # multi-device scan: per-shard top-k + cross-shard merge
-            n_local = snap.sharded_mat.shape[0] // snap.mesh.shape[snap.shard_axis]
-            k = min(how_many, n_local)
-            fn = _sharded_top_k_fn(snap.mesh, snap.shard_axis, k, snap.n)
-            vals, idx = fn(snap.sharded_mat, qs)
-            vals, idx = np.asarray(vals), np.asarray(idx)
+        if snap.sharded_mat is not None and not filtering:
+            vals, idx = self._sharded_query(snap, qs_host, how_many, excluded)
+            vals, idx = vals[:, :how_many], idx[:, :how_many]
             ids = snap.ids
             return [
                 [(ids[int(i)], float(v)) for v, i in zip(vals[b], idx[b])
                  if np.isfinite(v)]
                 for b in range(len(query_vecs))
             ]
+        qs = jnp.asarray(qs_host)
+        excl = jnp.asarray(self._excluded_indices(snap, excluded, len(qs_host)))
         if self.lsh is None or snap.buckets is None:
             valid = jnp.ones(snap.n, dtype=bool)
             k = min(
                 snap.n,
                 _round_up_pow2(max(2 * how_many, 64) if filtering else max(how_many, 16)),
             )
-            vals, idx = _top_k_dot_batch(snap.score_mat, qs, valid, k)
+            vals, idx = _top_k_dot_batch(snap.score_mat, qs, valid, excl, k)
         else:
             # per-query LSH candidate masks: (B, num_buckets) lookup table
             # indexed by item bucket on device
-            lut = np.zeros((len(qs_host), self.lsh.num_buckets), dtype=bool)
-            for b, q in enumerate(qs_host):
-                lut[b, self.lsh.get_candidate_indices(q)] = True
             k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
             vals, idx = _top_k_dot_batch_masked(
-                snap.score_mat, qs, jnp.asarray(lut), snap.buckets, k
+                snap.score_mat, qs, jnp.asarray(self._build_lut(qs_host)),
+                snap.buckets, excl, k
             )
         vals, idx = np.asarray(vals), np.asarray(idx)
         if not filtering:
@@ -379,7 +490,10 @@ class ALSServingModel(ServingModel):
             if len(got) < how_many and k < snap.n:
                 # heavy filtering consumed this query's candidates — fall back
                 # to the widening single-query path
-                got = self.top_n(qs_host[b], how_many, 0, allowed, None)
+                got = self.top_n(
+                    qs_host[b], how_many, 0, allowed, None,
+                    excluded=excluded[b] if excluded else None,
+                )
             out.append(got)
         return out
 

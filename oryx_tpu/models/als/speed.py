@@ -138,17 +138,43 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             return []
         yty_solver = model.yty_cache.get(blocking=True)
         xtx_solver = model.xtx_cache.get(blocking=True)
-        updates: list[str] = []
-        for (user, item), value in agg.items():
+
+        # gather the microbatch's vectors once, then fold in EVERY interaction
+        # with one batched solve per side — B k×k solves collapse into two
+        # stacked-RHS matmuls instead of a per-interaction host loop
+        # (the TPU answer to ALSSpeedModelManager.java:198-220's parallelStream)
+        pairs = list(agg.items())
+        B, k = len(pairs), model.features
+        xus = np.zeros((B, k), dtype=np.float32)
+        yis = np.zeros((B, k), dtype=np.float32)
+        has_xu = np.zeros(B, dtype=bool)
+        has_yi = np.zeros(B, dtype=bool)
+        values = np.empty(B, dtype=np.float64)
+        for b, ((user, item), value) in enumerate(pairs):
+            values[b] = value
             xu = model.x.get_vector(user)
             yi = model.y.get_vector(item)
-            if yty_solver is not None:
-                new_xu = foldin.compute_updated_xu(yty_solver, value, xu, yi, self.implicit)
-                if new_xu is not None:
-                    updates.append(json.dumps(["X", user, [float(v) for v in new_xu]]))
-            # symmetric item update (ALSSpeedModelManager.java:209-219)
-            if xtx_solver is not None:
-                new_yi = foldin.compute_updated_xu(xtx_solver, value, yi, xu, self.implicit)
-                if new_yi is not None:
-                    updates.append(json.dumps(["Y", item, [float(v) for v in new_yi]]))
+            if xu is not None:
+                xus[b], has_xu[b] = xu, True
+            if yi is not None:
+                yis[b], has_yi[b] = yi, True
+
+        new_x = new_y = None
+        changed_x = changed_y = None
+        if yty_solver is not None:
+            new_x, changed_x = foldin.compute_updated_batch(
+                yty_solver, values, xus, has_xu, yis, has_yi, self.implicit
+            )
+        # symmetric item update (ALSSpeedModelManager.java:209-219)
+        if xtx_solver is not None:
+            new_y, changed_y = foldin.compute_updated_batch(
+                xtx_solver, values, yis, has_yi, xus, has_xu, self.implicit
+            )
+
+        updates: list[str] = []
+        for b, ((user, item), _) in enumerate(pairs):
+            if new_x is not None and changed_x[b]:
+                updates.append(json.dumps(["X", user, [float(v) for v in new_x[b]]]))
+            if new_y is not None and changed_y[b]:
+                updates.append(json.dumps(["Y", item, [float(v) for v in new_y[b]]]))
         return updates

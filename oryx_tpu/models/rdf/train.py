@@ -113,7 +113,10 @@ def bin_features(
             if t.size and t[-1] >= col.max():
                 t = t[:-1]
             thresholds.append(t)
-            bins[:, j] = np.searchsorted(t, col, side="right").astype(np.int32)
+            # side="left": bin ≤ s ⇔ value ≤ t[s], matching _finalize_tree and
+            # the PMML greaterThan wire convention (value == threshold → left,
+            # as in reference RDFUpdate.java:545)
+            bins[:, j] = np.searchsorted(t, col, side="left").astype(np.int32)
             max_bins = max(max_bins, t.size + 1)
     return bins, thresholds, max_bins
 

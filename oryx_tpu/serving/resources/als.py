@@ -70,16 +70,20 @@ async def recommend(request: web.Request) -> web.Response:
     how_many, offset = get_how_many_offset(request)
     consider_known = request.query.get("considerKnownItems", "false") == "true"
     uv = check_exists(model.get_user_vector(user), user)
+    # known-item filtering rides the scan as a device-side mask (the sharded
+    # path needs no host fallback); rescorer hooks stay host-side callables
     known = set() if consider_known else model.get_known_items(user)
-    allowed = (lambda i: i not in known) if known else None
     provider = _rescorer_provider(request)
     rescorer = (
         provider.get_recommend_rescorer([user], get_rescorer_params(request))
         if provider
         else None
     )
-    allowed, rescore = _combine_allowed_rescore(allowed, rescorer)
-    results = await _run(request, lambda: model.top_n(uv, how_many, offset, allowed, rescore))
+    allowed, rescore = _combine_allowed_rescore(None, rescorer)
+    results = await _run(
+        request,
+        lambda: model.top_n(uv, how_many, offset, allowed, rescore, excluded=known),
+    )
     return render(request, [id_value(i, s) for i, s in results])
 
 
@@ -97,16 +101,16 @@ async def recommend_to_many(request: web.Request) -> web.Response:
     if not consider_known:
         for u in users:
             known |= model.get_known_items(u)
-    allowed = (lambda i: i not in known) if known else None
     provider = _rescorer_provider(request)
     rescorer = (
         provider.get_recommend_rescorer(users, get_rescorer_params(request))
         if provider
         else None
     )
-    allowed, rescore = _combine_allowed_rescore(allowed, rescorer)
+    allowed, rescore = _combine_allowed_rescore(None, rescorer)
     results = await _run(
-        request, lambda: model.top_n(mean_vec, how_many, offset, allowed, rescore)
+        request,
+        lambda: model.top_n(mean_vec, how_many, offset, allowed, rescore, excluded=known),
     )
     return render(request, [id_value(i, s) for i, s in results])
 
@@ -120,7 +124,6 @@ async def recommend_to_anonymous(request: web.Request) -> web.Response:
     vec = await _run(request, lambda: model.build_temporary_user_vector(pairs))
     check(vec is not None, "no solver available for model yet", 503)
     context_items = {i for i, _ in pairs}
-    allowed = lambda i: i not in context_items  # noqa: E731
     provider = _rescorer_provider(request)
     rescorer = (
         provider.get_recommend_to_anonymous_rescorer(
@@ -129,8 +132,11 @@ async def recommend_to_anonymous(request: web.Request) -> web.Response:
         if provider
         else None
     )
-    allowed, rescore = _combine_allowed_rescore(allowed, rescorer)
-    results = await _run(request, lambda: model.top_n(vec, how_many, offset, allowed, rescore))
+    allowed, rescore = _combine_allowed_rescore(None, rescorer)
+    results = await _run(
+        request,
+        lambda: model.top_n(vec, how_many, offset, allowed, rescore, excluded=context_items),
+    )
     return render(request, [id_value(i, s) for i, s in results])
 
 
@@ -148,15 +154,17 @@ async def recommend_with_context(request: web.Request) -> web.Response:
     known = {i for i, _ in pairs}
     if not consider_known:
         known |= model.get_known_items(user)
-    allowed = lambda i: i not in known  # noqa: E731
     provider = _rescorer_provider(request)
     rescorer = (
         provider.get_recommend_rescorer([user], get_rescorer_params(request))
         if provider
         else None
     )
-    allowed, rescore = _combine_allowed_rescore(allowed, rescorer)
-    results = await _run(request, lambda: model.top_n(vec, how_many, offset, allowed, rescore))
+    allowed, rescore = _combine_allowed_rescore(None, rescorer)
+    results = await _run(
+        request,
+        lambda: model.top_n(vec, how_many, offset, allowed, rescore, excluded=known),
+    )
     return render(request, [id_value(i, s) for i, s in results])
 
 

@@ -189,6 +189,43 @@ def test_forest_train_classification_separable():
     assert correct / len(X) > 0.9
 
 
+def test_forest_train_integer_threshold_tie_routing():
+    """Quantile thresholds landing exactly on (integer) data values must route
+    value == threshold LEFT both in training and at serving (ADVICE r1:
+    side="left" binning ⇔ PMML greaterThan; reference RDFUpdate.java:545)."""
+    vals = np.array([0, 1, 2, 3] * 50, dtype=np.float64)
+    X = vals[:, None]
+    y = (vals >= 2).astype(np.int64)  # boundary at 1/2: x<=1 left, x>=2 right
+    trees, _ = rdftrain.forest_train(
+        X, y, [False], [0],
+        task=rdftrain.CLASSIFICATION, n_classes=2, num_trees=1,
+        max_depth=4, max_split_candidates=8, impurity="gini",
+        rng=np.random.default_rng(1),
+    )
+    config = cfg.overlay_on(
+        {
+            "oryx.input-schema.feature-names": ["a", "label"],
+            "oryx.input-schema.categorical-features": ["label"],
+            "oryx.input-schema.target-feature": "label",
+        },
+        cfg.get_default(),
+    )
+    schema = InputSchema(config)
+    encodings = CategoricalValueEncodings({1: ["neg", "pos"]})
+    pmml = pmml_codec.forest_to_pmml(
+        trees, np.ones(1), schema, encodings,
+        max_depth=4, max_split_candidates=8, impurity="gini",
+    )
+    forest, enc2 = pmml_codec.read(pmmlutils.from_string(pmmlutils.to_string(pmml)))
+    e2v = enc2.get_encoding_value_map(1)
+    # every training value — including ones equal to a split threshold —
+    # must be served the label the trainer optimized for
+    for v, label in [(0.0, "neg"), (1.0, "neg"), (2.0, "pos"), (3.0, "pos")]:
+        ex = example_from_tokens([str(v), ""], schema, enc2)
+        pred = forest.predict(ex)
+        assert e2v[pred.most_probable_category_encoding] == label, v
+
+
 def test_forest_train_regression():
     rng = np.random.default_rng(0)
     X = rng.uniform(0, 10, size=(300, 1))
