@@ -56,13 +56,12 @@ def _attach_backend() -> None:
 
     The probe retries with backoff across the round (a flaky tunnel may come
     back), instead of giving up after one shot."""
-    for attempt, (timeout_sec, sleep_sec) in enumerate(
-        [(120, 15), (120, 45), (120, 0)], start=1
-    ):
+    schedule = [(120, 30), (120, 0)]
+    for attempt, (timeout_sec, sleep_sec) in enumerate(schedule, start=1):
         if _probe_default_backend(timeout_sec):
             return
         print(
-            f"backend probe {attempt}/3 failed (timeout {timeout_sec}s)",
+            f"backend probe {attempt}/{len(schedule)} failed (timeout {timeout_sec}s)",
             file=sys.stderr,
         )
         if sleep_sec:

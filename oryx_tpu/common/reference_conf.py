@@ -27,6 +27,9 @@ oryx = {
       topic = "OryxInput"
       key-class = "str"
       message-class = "str"
+      # Partition count used by topic-setup (reference oryx-run.sh:345 creates
+      # the input topic with 4); >1 lets consumer groups split the topic.
+      partitions = 1
     }
   }
 
@@ -38,6 +41,9 @@ oryx = {
       # Max message size; larger models are published by reference
       # (MODEL-REF) instead of inline (reference reference.conf:78).
       max-size = 16777216
+      # Update topic stays single-partition (oryx-run.sh:358): every
+      # speed/serving consumer must see every MODEL/UP message, in order.
+      partitions = 1
     }
   }
 
@@ -84,6 +90,8 @@ oryx = {
       secure-port = 8443
       user-name = null
       password = null
+      # "digest" (reference InMemoryRealm parity) or "basic" (over TLS)
+      auth-scheme = "digest"
       keystore-file = null
       keystore-password = null
       key-alias = null

@@ -65,53 +65,60 @@ class AbstractLayer:
                         f"topic {name} does not exist on {broker_url}; run topic-setup"
                     )
 
-    def input_start_offset(self) -> int:
-        """Resume position: stored offset for this oryx.id, else latest."""
+    def input_start_offset(self) -> dict[int, int]:
+        """Per-partition resume positions: stored offsets for this oryx.id,
+        else latest (AbstractSparkLayer.java:208-211)."""
         broker = tp.get_broker(self.input_broker)
-        if self._group:
-            stored = broker.get_offset(self._group, self.input_topic)
-            if stored is not None:
-                return stored
-        return broker.size(self.input_topic)
+        offsets: dict[int, int] = {}
+        for p in range(broker.num_partitions(self.input_topic)):
+            stored = broker.get_offset(self._group, self.input_topic, p) if self._group else None
+            offsets[p] = stored if stored is not None else broker.size(self.input_topic, p)
+        return offsets
 
-    def store_input_offset(self, offset: int) -> None:
-        """Write back consumed offsets (UpdateOffsetsFn.java)."""
+    def store_input_offset(self, offsets: dict[int, int]) -> None:
+        """Write back consumed per-partition offsets (UpdateOffsetsFn.java)."""
         if self._group:
-            tp.get_broker(self.input_broker).set_offset(self._group, self.input_topic, offset)
+            broker = tp.get_broker(self.input_broker)
+            for p, off in offsets.items():
+                broker.set_offset(self._group, self.input_topic, off, p)
 
     # -- microbatch pump ----------------------------------------------------
     def run_microbatches(
         self,
         on_batch: Callable[[int, Sequence[KeyMessage]], None],
         interval_sec: float | None = None,
-        start_offset: int | None = None,
+        start_offset: "dict[int, int] | None" = None,
     ) -> None:
-        """Every generation interval, hand the new input slice to on_batch —
-        the foreachRDD loop. Runs until stop; an on_batch exception is fatal
-        to the layer (reference fatal-on-error semantics).
+        """Every generation interval, hand the new input slice (across all
+        input partitions) to on_batch — the foreachRDD loop. Runs until stop;
+        an on_batch exception is fatal to the layer (reference fatal-on-error
+        semantics).
 
-        ``start_offset`` should be resolved synchronously in start() (see
-        resolve_start_offset) so input produced after start() returns is never
-        skipped by a slow-to-schedule pump thread."""
+        ``start_offset`` should be resolved synchronously in start() so input
+        produced after start() returns is never skipped by a slow-to-schedule
+        pump thread."""
         interval = interval_sec if interval_sec is not None else self.generation_interval_sec
         broker = tp.get_broker(self.input_broker)
-        offset = start_offset if start_offset is not None else self.input_start_offset()
+        offsets = dict(start_offset) if start_offset is not None else self.input_start_offset()
         while not self._stop.is_set():
             self._stop.wait(interval)
             if self._stop.is_set():
                 break
-            end = broker.size(self.input_topic)
             batch: list[KeyMessage] = []
-            while offset < end:
-                chunk = broker.read(self.input_topic, offset, end - offset)
-                if not chunk:
-                    break
-                batch.extend(km for km in chunk if km is not tp.CORRUPT_RECORD)
-                offset += len(chunk)
+            for p in range(broker.num_partitions(self.input_topic)):
+                offset = offsets.get(p, 0)
+                end = broker.size(self.input_topic, p)
+                while offset < end:
+                    chunk = broker.read(self.input_topic, offset, end - offset, partition=p)
+                    if not chunk:
+                        break
+                    batch.extend(km for km in chunk if km is not tp.CORRUPT_RECORD)
+                    offset += len(chunk)
+                offsets[p] = offset
             timestamp_ms = int(time.time() * 1000)
             with self.tracer.step("generation", n_items=len(batch)):
                 on_batch(timestamp_ms, batch)
-            self.store_input_offset(offset)
+            self.store_input_offset(offsets)
 
     # -- threads / lifecycle ------------------------------------------------
     def spawn(self, name: str, fn: Callable[[], None]) -> threading.Thread:

@@ -14,10 +14,15 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
+import hmac
 import importlib
 import logging
+import re
+import secrets
 import ssl
 import threading
+import time
 
 from aiohttp import web
 
@@ -48,7 +53,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     """Build the aiohttp application with resources from config
     (OryxApplication.java:54-96)."""
     middlewares = [rsrc.error_middleware, _compression_middleware]
-    auth_mw = _basic_auth_middleware(config)
+    auth_mw = _auth_middleware(config)
     if auth_mw is not None:
         middlewares.append(auth_mw)
     app = web.Application(middlewares=middlewares)
@@ -77,22 +82,125 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     return app
 
 
-def _basic_auth_middleware(config):
-    """Optional HTTP basic auth (reference uses a DIGEST realm,
-    ServingLayer.java:293-321; basic-over-TLS is the modern equivalent)."""
+_AUTH_REALM = "Oryx"
+
+
+def _auth_middleware(config):
+    """Optional HTTP auth behind oryx.serving.api.{user-name,password}:
+    DIGEST by default for wire parity with the reference's single-user
+    InMemoryRealm (ServingLayer.java:293-321); ``auth-scheme = basic`` opts
+    into basic-over-TLS."""
     user = config.get_string("oryx.serving.api.user-name", None)
-    password = config.get_string("oryx.serving.api.password", None)
     if not user:
         return None
-    expected = base64.b64encode(f"{user}:{password or ''}".encode()).decode()
+    password = config.get_string("oryx.serving.api.password", None) or ""
+    scheme = config.get_string("oryx.serving.api.auth-scheme", "digest").lower()
+    if scheme == "basic":
+        return _basic_auth_middleware(user, password)
+    if scheme != "digest":
+        raise ValueError(f"unknown oryx.serving.api.auth-scheme: {scheme}")
+    return _digest_auth_middleware(user, password)
+
+
+def _basic_auth_middleware(user: str, password: str):
+    expected = base64.b64encode(f"{user}:{password}".encode()).decode()
 
     @web.middleware
     async def auth(request, handler):
         header = request.headers.get("Authorization", "")
-        if header != f"Basic {expected}":
+        if not hmac.compare_digest(header, f"Basic {expected}"):
             return web.Response(
-                status=401, headers={"WWW-Authenticate": 'Basic realm="Oryx"'}
+                status=401,
+                headers={"WWW-Authenticate": f'Basic realm="{_AUTH_REALM}"'},
             )
+        return await handler(request)
+
+    return auth
+
+
+_DIGEST_FIELD_RE = re.compile(r'(\w+)=(?:"([^"]*)"|([^\s,]+))')
+_NONCE_TTL_SEC = 300
+
+
+def _digest_auth_middleware(user: str, password: str):
+    """RFC 7616/2617 digest challenge-response (MD5 and SHA-256, qop=auth).
+
+    Nonces are self-validating HMAC(timestamp) tokens — no server-side nonce
+    table — and expire after 5 minutes with ``stale=true`` so clients reauth
+    without re-prompting."""
+    server_key = secrets.token_bytes(16)
+
+    def make_nonce() -> str:
+        ts = str(int(time.time()))
+        sig = hmac.new(server_key, ts.encode(), hashlib.sha256).hexdigest()[:16]
+        return f"{ts}.{sig}"
+
+    def nonce_fresh(nonce: str) -> bool:
+        ts, _, sig = nonce.partition(".")
+        if not ts.isdigit():
+            return False
+        want = hmac.new(server_key, ts.encode(), hashlib.sha256).hexdigest()[:16]
+        return hmac.compare_digest(sig, want) and time.time() - int(ts) < _NONCE_TTL_SEC
+
+    def challenge(stale: bool = False) -> web.Response:
+        headers = []
+        for alg in ("SHA-256", "MD5"):  # RFC 7616: strongest first
+            h = (
+                f'Digest realm="{_AUTH_REALM}", qop="auth", algorithm={alg}, '
+                f'nonce="{make_nonce()}", charset=UTF-8'
+            )
+            if stale:
+                h += ", stale=true"
+            headers.append(("WWW-Authenticate", h))
+        resp = web.Response(status=401)
+        for k, v in headers:
+            resp.headers.add(k, v)
+        return resp
+
+    @web.middleware
+    async def auth(request, handler):
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Digest "):
+            return challenge()
+        fields = {
+            m.group(1).lower(): m.group(2) if m.group(2) is not None else m.group(3)
+            for m in _DIGEST_FIELD_RE.finditer(header[len("Digest "):])
+        }
+        try:
+            username = fields["username"]
+            realm = fields["realm"]
+            nonce = fields["nonce"]
+            uri = fields["uri"]
+            response = fields["response"]
+        except KeyError:
+            return challenge()
+        if username != user or realm != _AUTH_REALM:
+            return challenge()
+        if not nonce_fresh(nonce):
+            return challenge(stale=True)
+        algorithm = fields.get("algorithm", "MD5").upper()
+        if algorithm in ("MD5", "MD5-SESS"):
+            digest = lambda s: hashlib.md5(s.encode()).hexdigest()  # noqa: E731,S324
+        elif algorithm in ("SHA-256", "SHA-256-SESS"):
+            digest = lambda s: hashlib.sha256(s.encode()).hexdigest()  # noqa: E731
+        else:
+            return challenge()
+        ha1 = digest(f"{user}:{realm}:{password}")
+        if algorithm.endswith("-SESS"):
+            ha1 = digest(f"{ha1}:{nonce}:{fields.get('cnonce', '')}")
+        ha2 = digest(f"{request.method}:{uri}")
+        qop = fields.get("qop")
+        if qop == "auth":
+            expected = digest(
+                f"{ha1}:{nonce}:{fields.get('nc', '')}:"
+                f"{fields.get('cnonce', '')}:auth:{ha2}"
+            )
+        elif qop is None:
+            expected = digest(f"{ha1}:{nonce}:{ha2}")
+        else:
+            return challenge()  # qop=auth-int unsupported
+        if not hmac.compare_digest(response.lower(), expected):
+            return challenge()
         return await handler(request)
 
     return auth

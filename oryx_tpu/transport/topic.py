@@ -35,10 +35,12 @@ in-flight writer by stopping before it.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
+import zlib
 from pathlib import Path
 from typing import Iterator
 
@@ -48,6 +50,31 @@ from oryx_tpu.common import ioutils
 
 class TopicException(Exception):
     pass
+
+
+#: Seconds after which a consumer-group member with no heartbeat is dropped
+#: from partition assignment (Kafka session.timeout.ms equivalent).
+GROUP_MEMBER_TTL_SEC = 30.0
+
+
+def partition_for_key(key, n_partitions: int, fallback: int = 0) -> int:
+    """Stable key→partition routing (Kafka's hash-partitioner equivalent):
+    same key always lands on the same partition, so per-key ordering holds.
+    ``fallback`` routes None keys (callers pass a round-robin counter)."""
+    if n_partitions <= 1:
+        return 0
+    if key is None:
+        return fallback % n_partitions
+    return zlib.crc32(str(key).encode("utf-8")) % n_partitions
+
+
+def partitions_for_member(member_id: str, members: list[str], n_partitions: int) -> list[int]:
+    """Deterministic round-robin partition assignment over the sorted live
+    membership (the stand-in for Kafka's group rebalance protocol)."""
+    if not members or member_id not in members:
+        return []
+    rank = sorted(members).index(member_id)
+    return [p for p in range(n_partitions) if p % len(members) == rank]
 
 
 #: Placeholder returned for a corrupt log record so offsets stay aligned;
@@ -61,8 +88,11 @@ CORRUPT_RECORD = KeyMessage(None, None)
 
 
 class Broker:
-    """create/delete/exists + log access for one transport endpoint
-    (KafkaUtils equivalent)."""
+    """create/delete/exists + partitioned log access for one transport
+    endpoint (KafkaUtils equivalent). Topics are sets of append-only partition
+    logs; producers route by key hash (partition_for_key), consumers read
+    per-partition offsets. Single-partition topics (the default) behave as one
+    plain log."""
 
     def create_topic(self, name: str, partitions: int = 1) -> None:
         raise NotImplementedError
@@ -73,24 +103,34 @@ class Broker:
     def topic_exists(self, name: str) -> bool:
         raise NotImplementedError
 
+    def num_partitions(self, name: str) -> int:
+        raise NotImplementedError
+
     def append(self, topic: str, key, message) -> None:
+        """Route by key hash to a partition and append (None key round-robins)."""
         raise NotImplementedError
 
-    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
+    def read(
+        self, topic: str, offset: int, max_items: int = 1024, partition: int = 0
+    ) -> list[KeyMessage]:
         raise NotImplementedError
 
-    def size(self, topic: str) -> int:
-        """Latest offset (number of messages ever appended)."""
+    def size(self, topic: str, partition: int = 0) -> int:
+        """Latest offset of one partition (messages ever appended to it)."""
         raise NotImplementedError
 
-    def truncate(self, topic: str, before_offset: int) -> None:
+    def total_size(self, topic: str) -> int:
+        """Sum of all partition sizes (poll-wakeup bookkeeping)."""
+        return sum(self.size(topic, p) for p in range(self.num_partitions(topic)))
+
+    def truncate(self, topic: str, before_offset: int, partition: int = 0) -> None:
         """Drop messages below the given offset (retention stand-in). Offsets
         are stable: reads below the new base return nothing."""
         raise NotImplementedError
 
-    def wait_for_data(self, topic: str, offset: int, timeout: float, stop=None) -> None:
-        """Block until new data may exist, timeout elapses, or ``stop``
-        (a threading.Event) is set."""
+    def wait_for_data(self, topic: str, seen_total: int, timeout: float, stop=None) -> None:
+        """Block until the topic's total size may exceed ``seen_total``,
+        timeout elapses, or ``stop`` (a threading.Event) is set."""
         if stop is not None:
             stop.wait(timeout)
         else:
@@ -100,10 +140,23 @@ class Broker:
         """Wake blocked wait_for_data callers (consumer.wakeup())."""
 
     # offset store (ZK-equivalent control plane, KafkaUtils.java:120-188)
-    def get_offset(self, group: str, topic: str) -> int | None:
+    def get_offset(self, group: str, topic: str, partition: int = 0) -> int | None:
         raise NotImplementedError
 
-    def set_offset(self, group: str, topic: str, offset: int) -> None:
+    def set_offset(self, group: str, topic: str, offset: int, partition: int = 0) -> None:
+        raise NotImplementedError
+
+    # consumer groups (partition fan-out across cooperating consumers,
+    # KafkaUtils.java:63-107 / Kafka group membership equivalent)
+    def join_group(self, group: str, topic: str, member_id: str) -> None:
+        """Register/heartbeat a member; call at least every GROUP_MEMBER_TTL_SEC."""
+        raise NotImplementedError
+
+    def leave_group(self, group: str, topic: str, member_id: str) -> None:
+        raise NotImplementedError
+
+    def group_members(self, group: str, topic: str) -> list[str]:
+        """Live (heartbeat within TTL) member ids, sorted."""
         raise NotImplementedError
 
 
@@ -131,19 +184,28 @@ def reset_memory_brokers() -> None:
         _memory_brokers.clear()
 
 
-class _MemoryTopic:
-    __slots__ = ("log", "base", "cond")
+class _MemoryPartition:
+    __slots__ = ("log", "base")
 
     def __init__(self):
         self.log: list[KeyMessage] = []
         self.base = 0  # offset of log[0]; advances on truncate
-        self.cond = threading.Condition()
+
+
+class _MemoryTopic:
+    __slots__ = ("partitions", "cond", "rr")
+
+    def __init__(self, n_partitions: int):
+        self.partitions = [_MemoryPartition() for _ in range(n_partitions)]
+        self.cond = threading.Condition()  # one condition per topic
+        self.rr = itertools.count()  # round-robin for None keys
 
 
 class MemoryBroker(Broker):
     def __init__(self):
         self._topics: dict[str, _MemoryTopic] = {}
-        self._offsets: dict[tuple[str, str], int] = {}
+        self._offsets: dict[tuple[str, str, int], int] = {}
+        self._groups: dict[tuple[str, str], dict[str, float]] = {}
         self._lock = threading.Lock()
 
     def _topic(self, name: str) -> _MemoryTopic:
@@ -153,9 +215,15 @@ class MemoryBroker(Broker):
                 raise TopicException(f"topic does not exist: {name}")
             return t
 
+    def _partition(self, name: str, partition: int) -> _MemoryPartition:
+        t = self._topic(name)
+        if not 0 <= partition < len(t.partitions):
+            raise TopicException(f"no partition {partition} in topic {name}")
+        return t.partitions[partition]
+
     def create_topic(self, name: str, partitions: int = 1) -> None:
         with self._lock:
-            self._topics.setdefault(name, _MemoryTopic())
+            self._topics.setdefault(name, _MemoryTopic(max(1, partitions)))
 
     def delete_topic(self, name: str) -> None:
         with self._lock:
@@ -165,35 +233,50 @@ class MemoryBroker(Broker):
         with self._lock:
             return name in self._topics
 
+    def num_partitions(self, name: str) -> int:
+        return len(self._topic(name).partitions)
+
     def append(self, topic: str, key, message) -> None:
         t = self._topic(topic)
         with t.cond:
-            t.log.append(KeyMessage(key, message))
+            p = partition_for_key(key, len(t.partitions), next(t.rr))
+            t.partitions[p].log.append(KeyMessage(key, message))
             t.cond.notify_all()
 
-    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
+    def read(
+        self, topic: str, offset: int, max_items: int = 1024, partition: int = 0
+    ) -> list[KeyMessage]:
         t = self._topic(topic)
         with t.cond:
-            lo = max(offset - t.base, 0)
-            return t.log[lo:lo + max_items]
+            part = t.partitions[partition]
+            lo = max(offset - part.base, 0)
+            return part.log[lo:lo + max_items]
 
-    def size(self, topic: str) -> int:
+    def size(self, topic: str, partition: int = 0) -> int:
         t = self._topic(topic)
         with t.cond:
-            return t.base + len(t.log)
+            part = t.partitions[partition]
+            return part.base + len(part.log)
 
-    def truncate(self, topic: str, before_offset: int) -> None:
+    def total_size(self, topic: str) -> int:
         t = self._topic(topic)
         with t.cond:
-            drop = min(max(before_offset - t.base, 0), len(t.log))
+            return sum(p.base + len(p.log) for p in t.partitions)
+
+    def truncate(self, topic: str, before_offset: int, partition: int = 0) -> None:
+        t = self._topic(topic)
+        with t.cond:
+            part = t.partitions[partition]
+            drop = min(max(before_offset - part.base, 0), len(part.log))
             if drop:
-                del t.log[:drop]
-                t.base += drop
+                del part.log[:drop]
+                part.base += drop
 
-    def wait_for_data(self, topic: str, offset: int, timeout: float, stop=None) -> None:
+    def wait_for_data(self, topic: str, seen_total: int, timeout: float, stop=None) -> None:
         t = self._topic(topic)
         with t.cond:
-            if t.base + len(t.log) <= offset and not (stop is not None and stop.is_set()):
+            total = sum(p.base + len(p.log) for p in t.partitions)
+            if total <= seen_total and not (stop is not None and stop.is_set()):
                 t.cond.wait(timeout)
 
     def wake(self, topic: str) -> None:
@@ -204,50 +287,79 @@ class MemoryBroker(Broker):
         with t.cond:
             t.cond.notify_all()
 
-    def get_offset(self, group: str, topic: str) -> int | None:
+    def get_offset(self, group: str, topic: str, partition: int = 0) -> int | None:
         with self._lock:
-            return self._offsets.get((group, topic))
+            return self._offsets.get((group, topic, partition))
 
-    def set_offset(self, group: str, topic: str, offset: int) -> None:
+    def set_offset(self, group: str, topic: str, offset: int, partition: int = 0) -> None:
         with self._lock:
-            self._offsets[(group, topic)] = offset
+            self._offsets[(group, topic, partition)] = offset
+
+    def join_group(self, group: str, topic: str, member_id: str) -> None:
+        with self._lock:
+            self._groups.setdefault((group, topic), {})[member_id] = time.monotonic()
+
+    def leave_group(self, group: str, topic: str, member_id: str) -> None:
+        with self._lock:
+            self._groups.get((group, topic), {}).pop(member_id, None)
+
+    def group_members(self, group: str, topic: str) -> list[str]:
+        now = time.monotonic()
+        with self._lock:
+            members = self._groups.get((group, topic), {})
+            return sorted(
+                m for m, hb in members.items() if now - hb < GROUP_MEMBER_TTL_SEC
+            )
 
 
 class FileBroker(Broker):
-    """Append-only JSONL log per topic under a directory.
+    """Append-only JSONL logs (one per partition) per topic under a directory.
 
     Appends are single O_APPEND write syscalls, atomic between cooperating
-    processes on a local filesystem. Reads keep a per-topic byte index that
-    extends incrementally, so polling cost is O(new bytes), not O(log size).
-    A partial trailing line (in-flight writer) is left for the next read;
-    corrupt interior lines are skipped with a warning.
+    processes on a local filesystem. Reads keep a per-partition byte index
+    that extends incrementally, so polling cost is O(new bytes), not O(log
+    size). A partial trailing line (in-flight writer) is left for the next
+    read; corrupt interior lines are skipped with a warning. Consumer-group
+    membership rides heartbeat files (.groups/) with an mtime TTL, so
+    cooperating processes see each other without a coordinator.
     """
 
     def __init__(self, root: str):
         self._root = Path(root)
         ioutils.mkdirs(self._root)
         self._lock = threading.Lock()
-        # topic -> (line-start byte offsets incl. next-append position)
-        self._index: dict[str, list[int]] = {}
+        # (topic, partition) -> line-start byte offsets incl. next-append pos
+        self._index: dict[tuple[str, int], list[int]] = {}
+        self._rr = itertools.count()  # per-process round-robin for None keys
 
-    def _log_path(self, name: str) -> Path:
-        return self._root / name / "00000.jsonl"
+    def _log_path(self, name: str, partition: int = 0) -> Path:
+        return self._root / name / f"{partition:05d}.jsonl"
 
     def create_topic(self, name: str, partitions: int = 1) -> None:
-        p = self._log_path(name)
-        ioutils.mkdirs(p.parent)
-        p.touch(exist_ok=True)
+        d = self._root / name
+        ioutils.mkdirs(d)
+        for p in range(max(1, partitions)):
+            self._log_path(name, p).touch(exist_ok=True)
 
     def delete_topic(self, name: str) -> None:
         ioutils.delete_recursively(self._root / name)
         with self._lock:
-            self._index.pop(name, None)
+            for key in [k for k in self._index if k[0] == name]:
+                del self._index[key]
 
     def topic_exists(self, name: str) -> bool:
-        return self._log_path(name).exists()
+        return self._log_path(name, 0).exists()
+
+    def num_partitions(self, name: str) -> int:
+        d = self._root / name
+        if not d.is_dir():
+            raise TopicException(f"topic does not exist: {name}")
+        return max(1, len(list(d.glob("[0-9]*.jsonl"))))
 
     def append(self, topic: str, key, message) -> None:
-        p = self._log_path(topic)
+        n_parts = self.num_partitions(topic)
+        part = partition_for_key(key, n_parts, next(self._rr))
+        p = self._log_path(topic, part)
         if not p.exists():
             raise TopicException(f"topic does not exist: {topic}")
         data = (json.dumps({"k": key, "m": message}, separators=(",", ":")) + "\n").encode("utf-8")
@@ -261,13 +373,13 @@ class FileBroker(Broker):
         finally:
             os.close(fd)
 
-    def _refresh_index(self, topic: str) -> list[int]:
+    def _refresh_index(self, topic: str, partition: int = 0) -> list[int]:
         """Extend the line index over bytes appended since the last call."""
-        p = self._log_path(topic)
+        p = self._log_path(topic, partition)
         if not p.exists():
-            raise TopicException(f"topic does not exist: {topic}")
+            raise TopicException(f"topic/partition does not exist: {topic}/{partition}")
         with self._lock:
-            idx = self._index.setdefault(topic, [0])
+            idx = self._index.setdefault((topic, partition), [0])
             scanned = idx[-1]
             file_size = p.stat().st_size
             if file_size <= scanned:
@@ -284,13 +396,15 @@ class FileBroker(Broker):
                 pos = nl + 1
             return idx
 
-    def read(self, topic: str, offset: int, max_items: int = 1024) -> list[KeyMessage]:
-        idx = self._refresh_index(topic)
+    def read(
+        self, topic: str, offset: int, max_items: int = 1024, partition: int = 0
+    ) -> list[KeyMessage]:
+        idx = self._refresh_index(topic, partition)
         n = len(idx) - 1  # complete lines
         if offset >= n:
             return []
         end = min(offset + max_items, n)
-        p = self._log_path(topic)
+        p = self._log_path(topic, partition)
         out: list[KeyMessage] = []
         with open(p, "rb") as f:
             f.seek(idx[offset])
@@ -315,20 +429,20 @@ class FileBroker(Broker):
                 out.append(CORRUPT_RECORD)  # keep offsets aligned
         return out[: end - offset]
 
-    def size(self, topic: str) -> int:
-        return len(self._refresh_index(topic)) - 1
+    def size(self, topic: str, partition: int = 0) -> int:
+        return len(self._refresh_index(topic, partition)) - 1
 
-    def truncate(self, topic: str, before_offset: int) -> None:
-        """Rewrite the log without the truncated prefix. Offsets shift to
-        0-based on disk but this broker instance keeps serving stable offsets
-        only for fresh reads; cross-process readers should truncate during
-        quiet periods (retention maintenance)."""
-        idx = self._refresh_index(topic)
+    def truncate(self, topic: str, before_offset: int, partition: int = 0) -> None:
+        """Rewrite the partition log without the truncated prefix. Offsets
+        shift to 0-based on disk but this broker instance keeps serving stable
+        offsets only for fresh reads; cross-process readers should truncate
+        during quiet periods (retention maintenance)."""
+        idx = self._refresh_index(topic, partition)
         n = len(idx) - 1
         cut = min(max(before_offset, 0), n)
         if cut == 0:
             return
-        p = self._log_path(topic)
+        p = self._log_path(topic, partition)
         with open(p, "rb") as f:
             f.seek(idx[cut])
             rest = f.read()
@@ -336,20 +450,50 @@ class FileBroker(Broker):
         tmp.write_bytes(rest)
         tmp.replace(p)
         with self._lock:
-            self._index.pop(topic, None)
+            self._index.pop((topic, partition), None)
 
-    def get_offset(self, group: str, topic: str) -> int | None:
-        p = self._root / ".offsets" / f"{group}__{topic}.json"
+    def _offset_path(self, group: str, topic: str, partition: int) -> Path:
+        # partition 0 keeps the legacy filename so old deployments resume
+        suffix = "" if partition == 0 else f"__p{partition}"
+        return self._root / ".offsets" / f"{group}__{topic}{suffix}.json"
+
+    def get_offset(self, group: str, topic: str, partition: int = 0) -> int | None:
+        p = self._offset_path(group, topic, partition)
         if not p.exists():
             return None
         return json.loads(p.read_text())["offset"]
 
-    def set_offset(self, group: str, topic: str, offset: int) -> None:
-        p = self._root / ".offsets" / f"{group}__{topic}.json"
+    def set_offset(self, group: str, topic: str, offset: int, partition: int = 0) -> None:
+        p = self._offset_path(group, topic, partition)
         ioutils.mkdirs(p.parent)
         tmp = p.with_suffix(".tmp")
         tmp.write_text(json.dumps({"offset": offset}))
         tmp.replace(p)
+
+    def _group_dir(self, group: str, topic: str) -> Path:
+        return self._root / ".groups" / f"{group}__{topic}"
+
+    def join_group(self, group: str, topic: str, member_id: str) -> None:
+        d = self._group_dir(group, topic)
+        ioutils.mkdirs(d)
+        (d / f"{member_id}.hb").touch()
+
+    def leave_group(self, group: str, topic: str, member_id: str) -> None:
+        try:
+            (self._group_dir(group, topic) / f"{member_id}.hb").unlink()
+        except FileNotFoundError:
+            pass
+
+    def group_members(self, group: str, topic: str) -> list[str]:
+        d = self._group_dir(group, topic)
+        if not d.is_dir():
+            return []
+        now = time.time()
+        return sorted(
+            p.name[: -len(".hb")]
+            for p in d.glob("*.hb")
+            if now - p.stat().st_mtime < GROUP_MEMBER_TTL_SEC
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -393,11 +537,17 @@ class TopicProducerImpl:
 
 
 class ConsumeDataIterator(Iterator[KeyMessage]):
-    """Blocking iterator over a topic from a starting offset, with exponential
-    poll backoff 1→1000 ms and wakeup-based close
+    """Blocking iterator over a topic's partitions from starting offsets, with
+    exponential poll backoff 1→1000 ms and wakeup-based close
     (kafka-util/.../ConsumeDataIterator.java:30-77).
 
-    ``start_offset``: int offset, or "earliest" (0), or "latest" (current end).
+    ``start_offset``: "earliest" (0), "latest" (current end), an int (only
+    valid when consuming exactly one partition), or a {partition: offset}
+    dict. ``partitions`` restricts consumption to a fixed subset; ``group``
+    joins a consumer group instead — the broker's live membership splits the
+    topic's partitions round-robin (partitions_for_member), re-evaluated every
+    poll so consumers that join/leave rebalance without a coordinator.
+
     Offset *persistence* is deliberately not done here: layers commit consumed
     positions after processing (UpdateOffsetsFn semantics) via
     Broker.set_offset.
@@ -405,27 +555,77 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
 
     _MIN_BACKOFF = 0.001
     _MAX_BACKOFF = 1.0
+    _HEARTBEAT_SEC = 1.0
 
     def __init__(
         self,
         broker: Broker | str,
         topic: str,
-        start_offset: "int | str" = "earliest",
+        start_offset: "int | str | dict" = "earliest",
+        partitions: "list[int] | None" = None,
+        group: "str | None" = None,
+        member_id: "str | None" = None,
     ):
         self._broker = get_broker(broker) if isinstance(broker, str) else broker
         self._topic = topic
-        if start_offset == "earliest":
-            self._offset = 0
+        self._group = group
+        self._member_id = member_id or f"consumer-{os.getpid()}-{id(self):x}"
+        self._n_parts = self._broker.num_partitions(topic)
+        self._partitions = partitions
+        if group is not None:
+            self._broker.join_group(group, topic, self._member_id)
+        self._last_heartbeat = time.monotonic()
+        self._start = start_offset
+        self._offsets: dict[int, int] = {}
+        if isinstance(start_offset, dict):
+            self._offsets.update({int(p): int(o) for p, o in start_offset.items()})
         elif start_offset == "latest":
-            self._offset = self._broker.size(topic)
-        else:
-            self._offset = int(start_offset)
+            # pin "latest" at subscribe time, for every partition — anything
+            # produced after construction must be seen even if the first poll
+            # is slow to schedule
+            for p in range(self._n_parts):
+                self._offsets[p] = self._broker.size(topic, p)
+        elif start_offset != "earliest":
+            static = partitions if partitions is not None else list(range(self._n_parts))
+            if group is None and len(static) == 1:
+                self._offsets[static[0]] = int(start_offset)
+            elif group is None and self._n_parts == 1:
+                self._offsets[0] = int(start_offset)
+            else:
+                raise TopicException(
+                    "int start_offset is ambiguous over multiple partitions; "
+                    "pass a {partition: offset} dict"
+                )
         self._buffer: list[KeyMessage] = []
         self._closed = threading.Event()
 
+    # -- partition assignment -------------------------------------------------
+    def _assigned(self) -> list[int]:
+        if self._group is not None:
+            now = time.monotonic()
+            if now - self._last_heartbeat >= self._HEARTBEAT_SEC:
+                self._broker.join_group(self._group, self._topic, self._member_id)
+                self._last_heartbeat = now
+            members = self._broker.group_members(self._group, self._topic)
+            assigned = partitions_for_member(self._member_id, members, self._n_parts)
+            if self._partitions is not None:
+                assigned = [p for p in assigned if p in self._partitions]
+            return assigned
+        if self._partitions is not None:
+            return list(self._partitions)
+        return list(range(self._n_parts))
+
+    def _offset_of(self, partition: int) -> int:
+        return self._offsets.setdefault(partition, 0)
+
     @property
     def offset(self) -> int:
-        return self._offset
+        """Single-partition position (back-compat for 1-partition topics)."""
+        return self._offset_of(0)
+
+    @property
+    def offsets(self) -> dict[int, int]:
+        return dict(self._offsets)
 
     def __iter__(self) -> "ConsumeDataIterator":
         return self
@@ -435,28 +635,45 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         while not self._buffer:
             if self._closed.is_set():
                 raise StopIteration
-            batch = self._broker.read(self._topic, self._offset)
-            if batch:
-                self._offset += len(batch)
-                self._buffer = [km for km in batch if km is not CORRUPT_RECORD]
-                if not self._buffer:
-                    continue
+            progressed = False
+            for p in self._assigned():
+                off = self._offset_of(p)
+                batch = self._broker.read(self._topic, off, partition=p)
+                if batch:
+                    self._offsets[p] = off + len(batch)
+                    self._buffer.extend(
+                        km for km in batch if km is not CORRUPT_RECORD
+                    )
+                    progressed = True
+            if self._buffer:
                 break
-            self._broker.wait_for_data(self._topic, self._offset, backoff, stop=self._closed)
+            if progressed:
+                continue  # consumed only corrupt records; poll again
+            self._broker.wait_for_data(
+                self._topic, self._broker.total_size(self._topic), backoff,
+                stop=self._closed,
+            )
             backoff = min(backoff * 2, self._MAX_BACKOFF)
         return self._buffer.pop(0)
 
     def close(self) -> None:
         """Wake up and terminate a blocked iteration (consumer.wakeup())."""
         self._closed.set()
+        if self._group is not None:
+            try:
+                self._broker.leave_group(self._group, self._topic, self._member_id)
+            except Exception:  # noqa: BLE001 — best-effort on teardown
+                pass
         self._broker.wake(self._topic)
 
 
 def maybe_create_topics(config, *topic_keys: str) -> None:
-    """Assert/create the configured topics (AbstractSparkLayer.java:178-185 +
-    oryx-run.sh kafka-setup). topic_keys like 'input-topic', 'update-topic'."""
+    """Assert/create the configured topics with their configured partition
+    counts (AbstractSparkLayer.java:178-185 + oryx-run.sh kafka-setup:345-358).
+    topic_keys like 'input-topic', 'update-topic'."""
     for tk in topic_keys:
         broker = get_broker(config.get_string(f"oryx.{tk}.broker"))
         name = config.get_string(f"oryx.{tk}.message.topic")
         if not broker.topic_exists(name):
-            broker.create_topic(name)
+            parts = config.get_int(f"oryx.{tk}.message.partitions", 1) or 1
+            broker.create_topic(name, parts)

@@ -1,7 +1,16 @@
-"""Multi-host bootstrap config tests (SURVEY §5.8; single-host no-op path —
-actually joining a job needs multiple processes, exercised on real pods)."""
+"""Multi-host bootstrap tests (SURVEY §5.8): single-host no-op path plus a
+REAL two-process localhost jax.distributed job (VERDICT r1 #10) — each rank
+runs initialize_from_config through oryx.distributed.* config and reports
+process_count/process_index plus a cross-host psum."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
 
 from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils
 from oryx_tpu.parallel import distributed
 
 
@@ -14,3 +23,75 @@ def test_config_keys_exist():
     config = cfg.get_default()
     assert config.get_string("oryx.distributed.coordinator", None) is None
     assert config.get_int("oryx.distributed.num-processes", None) is None
+
+
+_RANK_PROG = textwrap.dedent(
+    """
+    import json, os, sys
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.parallel import distributed
+
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    config = cfg.overlay_on(
+        {
+            "oryx.distributed.coordinator": coordinator,
+            "oryx.distributed.num-processes": 2,
+            "oryx.distributed.process-id": rank,
+        },
+        cfg.get_default(),
+    )
+    assert distributed.initialize_from_config(config) is True
+    assert distributed.is_initialized() is True
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    # one collective across the two processes proves the runtime is live
+    total = multihost_utils.process_allgather(jnp.asarray([rank + 1.0]))
+    print(
+        json.dumps(
+            {
+                "rank": jax.process_index(),
+                "count": jax.process_count(),
+                "devices": len(jax.devices()),
+                "allgather_sum": float(total.sum()),
+            }
+        )
+    )
+    """
+)
+
+
+def test_two_process_localhost_job():
+    """Two ranks join a localhost coordinator; both must see
+    process_count()==2 and agree on a cross-process allgather."""
+    port = ioutils.choose_free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # one device per process is plenty
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RANK_PROG, coordinator, str(rank)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()[-2000:]
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    assert {o["rank"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["count"] == 2
+        assert o["devices"] >= 2  # global view spans both processes
+        assert o["allgather_sum"] == 3.0  # (0+1) + (1+1)

@@ -231,6 +231,7 @@ def test_read_only_and_auth(tmp_path):
             "oryx.serving.api.read-only": True,
             "oryx.serving.api.user-name": "oryx",
             "oryx.serving.api.password": "pass",
+            "oryx.serving.api.auth-scheme": "basic",
             "oryx.serving.model-manager-class":
                 "oryx_tpu.models.als.serving.ALSServingModelManager",
             "oryx.serving.application-resources": "oryx_tpu.serving.resources.als",
@@ -245,6 +246,49 @@ def test_read_only_and_auth(tmp_path):
             assert c.post("/ingest", content="a,b,1").status_code == 401  # no auth
         with httpx.Client(base_url=base, timeout=10, auth=("oryx", "pass")) as c:
             assert c.post("/ingest", content="a,b,1").status_code == 403  # read-only
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
+
+
+def test_digest_auth(tmp_path):
+    """RFC 7616 digest challenge/response — the default scheme, for wire
+    parity with the reference's DIGEST InMemoryRealm
+    (ServingLayer.java:293-321)."""
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.api.user-name": "oryx",
+            "oryx.serving.api.password": "pass",
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.serving.resources.als",
+        },
+        cfg.get_default(),
+    )
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with httpx.Client(base_url=base, timeout=10) as c:
+            r = c.get("/ready")
+            assert r.status_code == 401  # no credentials
+            challenges = r.headers.get_list("WWW-Authenticate")
+            assert any(ch.startswith("Digest ") for ch in challenges)
+            assert any('qop="auth"' in ch for ch in challenges)
+            # basic credentials must NOT satisfy a digest realm
+            assert c.get("/ready", auth=("oryx", "pass")).status_code == 401
+        # httpx's DigestAuth implements the client side of the handshake
+        with httpx.Client(
+            base_url=base, timeout=10, auth=httpx.DigestAuth("oryx", "pass")
+        ) as c:
+            assert c.get("/ready").status_code in (200, 503)  # authed through
+        with httpx.Client(
+            base_url=base, timeout=10, auth=httpx.DigestAuth("oryx", "WRONG")
+        ) as c:
+            assert c.get("/ready").status_code == 401
     finally:
         layer.close()
         tp.reset_memory_brokers()

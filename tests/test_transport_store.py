@@ -173,6 +173,108 @@ def test_maybe_create_topics():
 # -- datastore ----------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Partitions + consumer groups (VERDICT r1 #8; KafkaUtils.java:63-107,
+# oryx-run.sh:345 input topic = 4 partitions)
+# ---------------------------------------------------------------------------
+
+
+def _partitioned_broker(url, n=4):
+    broker = tp.get_broker(url)
+    broker.create_topic("P", partitions=n)
+    return broker
+
+
+@pytest.mark.parametrize("url", ["memory:", "file"])
+def test_key_hash_partition_routing(url, tmp_path):
+    broker = _partitioned_broker(url if url == "memory:" else f"file:{tmp_path}/b")
+    assert broker.num_partitions("P") == 4
+    for i in range(40):
+        broker.append("P", f"k{i}", f"m{i}")
+    sizes = [broker.size("P", p) for p in range(4)]
+    assert sum(sizes) == 40
+    assert sum(1 for s in sizes if s > 0) >= 2  # really spread out
+    # same key always lands on the same partition (per-key ordering)
+    broker.append("P", "k0", "again")
+    p0 = tp.partition_for_key("k0", 4)
+    msgs = [km.message for km in broker.read("P", 0, 100, partition=p0)]
+    assert "m0" in msgs and "again" in msgs
+    assert msgs.index("m0") < msgs.index("again")
+
+
+@pytest.mark.parametrize("url", ["memory:", "file"])
+def test_two_consumer_group_fanout(url, tmp_path):
+    """Two consumers in one group split a 4-partition topic: every message is
+    seen exactly once across the pair."""
+    broker = _partitioned_broker(url if url == "memory:" else f"file:{tmp_path}/b")
+    for i in range(60):
+        broker.append("P", f"k{i}", f"m{i}")
+    it1 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="c1")
+    it2 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="c2")
+    assert broker.group_members("g", "P") == ["c1", "c2"]
+    assert sorted(
+        tp.partitions_for_member("c1", ["c1", "c2"], 4)
+        + tp.partitions_for_member("c2", ["c1", "c2"], 4)
+    ) == [0, 1, 2, 3]
+
+    got1, got2 = [], []
+    deadline = time.time() + 10
+    while len(got1) + len(got2) < 60 and time.time() < deadline:
+        for it, got in ((it1, got1), (it2, got2)):
+            try:
+                before = len(got)
+                while True:
+                    got.append(next(it).message)
+                    if len(got) - before > 60:
+                        break
+            except StopIteration:
+                pass
+            # drain what is buffered without blocking forever: close after
+            break_on_empty = True
+        if len(got1) + len(got2) >= 60:
+            break
+    it1.close()
+    it2.close()
+    assert sorted(got1 + got2) == sorted(f"m{i}" for i in range(60))
+    assert got1 and got2  # both consumers actually shared the work
+    assert not (set(got1) & set(got2))  # no duplicates
+
+
+def test_group_rebalance_on_leave():
+    """When a member leaves, the survivor picks up its partitions."""
+    broker = _partitioned_broker("memory:")
+    it1 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="a")
+    it2 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="b")
+    assert tp.partitions_for_member("a", ["a", "b"], 4) == [0, 2]
+    it2.close()  # leaves the group
+    assert broker.group_members("g", "P") == ["a"]
+    assert tp.partitions_for_member("a", ["a"], 4) == [0, 1, 2, 3]
+    for i in range(8):
+        broker.append("P", f"k{i}", f"m{i}")
+    got = sorted(next(it1).message for _ in range(8))  # sees ALL partitions now
+    assert got == sorted(f"m{i}" for i in range(8))
+    it1.close()
+
+
+def test_per_partition_offset_store(tmp_path):
+    broker = tp.get_broker(f"file:{tmp_path}/b")
+    broker.create_topic("P", partitions=3)
+    for p, off in ((0, 5), (1, 7), (2, 9)):
+        broker.set_offset("g", "P", off, partition=p)
+    assert [broker.get_offset("g", "P", p) for p in range(3)] == [5, 7, 9]
+    # partition 0 keeps the legacy single-partition filename
+    assert (tmp_path / "b" / ".offsets" / "g__P.json").exists()
+
+
+def test_int_start_offset_rejected_on_multipartition():
+    broker = _partitioned_broker("memory:")
+    with pytest.raises(tp.TopicException):
+        tp.ConsumeDataIterator(broker, "P", 3)
+    # but a per-partition dict works
+    it = tp.ConsumeDataIterator(broker, "P", {0: 0, 1: 0, 2: 0, 3: 0})
+    it.close()
+
+
 def test_datastore_write_read_gc(tmp_path):
     ds = DataStore(str(tmp_path / "data"))
     assert ds.write_segment(1000, []) is None  # empty interval skipped
