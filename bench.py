@@ -106,19 +106,77 @@ def main() -> None:
     qps = n_done / elapsed
     import jax
 
-    print(
-        json.dumps(
-            {
-                "metric": "als_recommend_throughput_1M_items_50f",
-                "value": round(qps, 1),
-                "unit": "recs/s",
-                "vs_baseline": round(qps / BASELINE_QPS, 2),
-                # which backend produced the number — a CPU-fallback figure
-                # must never be mistaken for the TPU result
-                "backend": jax.default_backend(),
-            }
+    # single-query latency percentiles (reference: 7 ms @ LSH 0.3, 50 feat,
+    # 1M items). Per-call numbers here include the axon tunnel's ~80 ms RTT
+    # on every device call — physically unavoidable in this environment and
+    # absent from a real co-located deployment; reported raw, with the
+    # batched-throughput figure carrying the honest capacity story.
+    _ = model.top_n(queries[0], HOW_MANY)  # compile the single-query program
+    lats = []
+    for i in range(100):
+        t1 = time.perf_counter()
+        _ = model.top_n(queries[(i * 37) % N_QUERY_USERS], HOW_MANY)
+        lats.append((time.perf_counter() - t1) * 1000.0)
+    lats.sort()
+
+    # LSH sample-rate 0.3 run — the reference's own best configuration,
+    # exercising the per-query LUT masking path
+    lsh_model = ALSServingModel(FEATURES, implicit=True, sample_rate=0.3)
+    lsh_model.bulk_load_items(item_ids, y)
+    _ = lsh_model.top_n_batch(queries[:BATCH], HOW_MANY)
+    n_lsh = 0
+    t2 = time.perf_counter()
+    while n_lsh < N_QUERY_USERS or time.perf_counter() - t2 < 3.0:
+        start = n_lsh % N_QUERY_USERS
+        batch = queries[start:start + BATCH]
+        if len(batch) < BATCH:
+            batch = queries[:BATCH]
+        _ = lsh_model.top_n_batch(batch, HOW_MANY)
+        n_lsh += len(batch)
+    lsh_qps = n_lsh / (time.perf_counter() - t2)
+
+    record = {
+        "metric": "als_recommend_throughput_1M_items_50f",
+        "value": round(qps, 1),
+        "unit": "recs/s",
+        "vs_baseline": round(qps / BASELINE_QPS, 2),
+        # which backend produced the number — a CPU-fallback figure
+        # must never be mistaken for the TPU result
+        "backend": jax.default_backend(),
+        "latency_ms": {
+            "p50": round(lats[49], 2),
+            "p99": round(lats[98], 2),
+            "note": "single-query, includes ~80ms tunnel RTT per device call",
+        },
+        "lsh_03": {
+            "value": round(lsh_qps, 1),
+            "unit": "recs/s",
+            "vs_baseline": round(lsh_qps / BASELINE_QPS, 2),
+        },
+    }
+
+    # batch-training throughput rides along in the same record (BASELINE.md
+    # metric is "batch ratings/sec/chip + serving recs/s"); a subprocess, both
+    # because batch and serving are separate processes in the lambda
+    # architecture and because a resident serving model measurably slows
+    # same-process training (~6x observed); failures must not take down the
+    # headline serving number
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "bench_batch.py")],
+            capture_output=True, text=True, timeout=480,
         )
-    )
+        if proc.returncode != 0:
+            record["batch"] = {
+                "error": f"exit {proc.returncode}",
+                "stderr_tail": proc.stderr[-500:],
+            }
+        else:
+            record["batch"] = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        record["batch"] = {"error": f"{type(e).__name__}: {e}"}
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

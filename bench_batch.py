@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Batch-ALS training throughput benchmark (BASELINE.md "Batch layer").
+
+The reference publishes no absolute batch numbers ("resources required ...
+are just that of the underlying MLlib implementations",
+docs/docs/performance.html) — the north star is ALS batch ratings/sec/chip
+at reference scale. This measures the block-partitioned trainer
+(oryx_tpu/models/als/train.py) on a synthetic MovieLens-25M-shaped problem:
+1M users x 100k items, 10M implicit ratings, 50 features.
+
+Metric: ratings/sec = nnz * iterations / wall (the standard ALS throughput
+measure: one "rating processed" = one nnz visited in one alternation).
+Also reports peak RSS — the point of the blocked solver is that the
+footprint stays bounded at reference scale (VERDICT r3 missing #2).
+
+Standalone: prints one JSON line. Also importable (bench.py folds the
+result into the round benchmark record).
+"""
+
+import json
+import resource
+import sys
+import time
+
+import numpy as np
+
+N_USERS = 1_000_000
+N_ITEMS = 100_000
+NNZ = 10_000_000
+FEATURES = 50
+ITERATIONS = 3
+
+
+class _FakeIDs:
+    """len()-only stand-in for IDIndexMapping: benchmark rows are already
+    dense indices, and materializing 1M id strings would only measure the
+    host dict, not the trainer."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+
+def run_batch_bench(
+    n_users: int = N_USERS,
+    n_items: int = N_ITEMS,
+    nnz: int = NNZ,
+    features: int = FEATURES,
+    iterations: int = ITERATIONS,
+) -> dict:
+    from oryx_tpu.models.als import train as als_train_mod
+    from oryx_tpu.models.als.data import RatingBatch
+
+    rng = np.random.default_rng(42)
+    batch = RatingBatch(
+        rng.integers(0, n_users, nnz).astype(np.int32),
+        rng.integers(0, n_items, nnz).astype(np.int32),
+        np.ones(nnz, dtype=np.float32),
+        _FakeIDs(n_users),
+        _FakeIDs(n_items),
+    )
+    kwargs = dict(
+        features=features, lam=0.001, alpha=1.0, implicit=True,
+    )
+    import jax
+
+    # warm-up: compiles both half-iteration programs (block/chunk statics are
+    # identical for the timed run, so the jit cache carries over)
+    x, y = als_train_mod.als_train(
+        batch, iterations=1, key=jax.random.PRNGKey(0), **kwargs
+    )
+    x.block_until_ready()
+
+    t0 = time.perf_counter()
+    x, y = als_train_mod.als_train(
+        batch, iterations=iterations, key=jax.random.PRNGKey(0), **kwargs
+    )
+    x.block_until_ready()
+    y.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    ratings_per_s = nnz * iterations / elapsed
+    return {
+        "metric": f"als_batch_train_throughput_{nnz // 1_000_000}M_{features}f",
+        "value": round(ratings_per_s, 1),
+        "unit": "ratings/s",
+        "elapsed_s": round(elapsed, 2),
+        "iterations": iterations,
+        "n_users": n_users,
+        "n_items": n_items,
+        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
+        "backend": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    print(json.dumps(run_batch_bench()))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

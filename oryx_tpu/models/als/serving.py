@@ -34,6 +34,7 @@ from oryx_tpu.models.als import pmml_codec
 from oryx_tpu.models.als.lsh import LocalitySensitiveHash
 from oryx_tpu.models.als.rescorer import load_rescorer_providers
 from oryx_tpu.models.als.vectors import FeatureVectorStore
+from oryx_tpu.common.lockutils import RateLimitCheck
 from oryx_tpu.ops.solver import SolverCache
 
 log = logging.getLogger(__name__)
@@ -52,13 +53,6 @@ def _score(qs, mat):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _top_k_dot(mat, q, valid, k: int):
-    scores = _score(q[None, :], mat)[0]
-    scores = jnp.where(valid, scores, -jnp.inf)
-    return jax.lax.top_k(scores, k)
-
-
 def _mask_excluded(scores, excl):
     """Per-query exclusion scatter: ``excl`` is (B, E) row indices, -1-padded.
     Out-of-range entries are remapped to n (a drop index): negative scatter
@@ -72,11 +66,18 @@ def _mask_excluded(scores, excl):
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_dot_batch(mat, qs, valid, excl, k: int):
-    scores = _score(qs, mat)  # (B, n) — one MXU matmul for the whole batch
-    scores = jnp.where(valid[None, :], scores, -jnp.inf)
-    scores = _mask_excluded(scores, excl)
-    # approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's
-    # own approximation); exact on backends without the TPU op
+    """One MXU matmul for the whole query batch + approx top-k. ``valid`` /
+    ``excl`` are None on the unfiltered hot path so it stays exactly
+    matmul + top_k (None is a static pytree — XLA never sees a dummy mask;
+    the r1→r2 CPU regression was unconditional masking here).
+
+    approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's own
+    approximation); exact on backends without the TPU op."""
+    scores = _score(qs, mat)  # (B, n)
+    if valid is not None:
+        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    if excl is not None:
+        scores = _mask_excluded(scores, excl)
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
@@ -85,12 +86,14 @@ def _top_k_dot_batch_masked(mat, qs, lut, buckets, excl, k: int):
     scores = _score(qs, mat)  # (B, n)
     valid = jnp.take_along_axis(lut, buckets[None, :], axis=1)  # (B, n)
     scores = jnp.where(valid, scores, -jnp.inf)
-    scores = _mask_excluded(scores, excl)
+    if excl is not None:
+        scores = _mask_excluded(scores, excl)
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
 @functools.lru_cache(maxsize=32)
-def _sharded_top_k_fn(mesh, axis: str, k: int, k_final: int, n_real: int, use_lut: bool):
+def _sharded_top_k_fn(mesh, axis: str, k: int, k_final: int, n_real: int,
+                      use_lut: bool, use_excl: bool = True):
     """Cross-shard top-N: Y's rows shard over ``axis``; each device scores
     its block, masks (pad rows, per-query LSH lut, per-query excluded items)
     and takes a local top-k; the (B, ndev·k) candidates merge with one more
@@ -116,11 +119,12 @@ def _sharded_top_k_fn(mesh, axis: str, k: int, k_final: int, n_real: int, use_lu
                 lut_blk, buckets_blk[None, :].astype(jnp.int32), axis=1
             )
             scores = jnp.where(valid, scores, -jnp.inf)
-        # per-query exclusions: global→local rebase; -1 pads and rows owned
-        # by other shards are remapped to the drop index (negative scatter
-        # indices would wrap, so clamp explicitly)
-        local_excl = excl_blk - offset
-        scores = _mask_excluded(scores, local_excl)
+        if use_excl:
+            # per-query exclusions: global→local rebase; -1 pads and rows
+            # owned by other shards are remapped to the drop index (negative
+            # scatter indices would wrap, so clamp explicitly)
+            local_excl = excl_blk - offset
+            scores = _mask_excluded(scores, local_excl)
         vals, idx = jax.lax.top_k(scores, k)
         return vals, idx + offset
 
@@ -382,9 +386,14 @@ class ALSServingModel(ServingModel):
             if use_lut
             else jnp.zeros((B, 1), dtype=bool)
         )
-        excl = jnp.asarray(self._excluded_indices(snap, excluded, B))
+        use_excl = excluded is not None and any(e for e in excluded)
+        excl = jnp.asarray(
+            self._excluded_indices(snap, excluded, B)
+            if use_excl
+            else np.full((B, 1), -1, dtype=np.int32)  # fixed shard_map arity
+        )
         fn = _sharded_top_k_fn(
-            snap.mesh, snap.shard_axis, k, k_final, snap.n, use_lut
+            snap.mesh, snap.shard_axis, k, k_final, snap.n, use_lut, use_excl
         )
         vals, idx = fn(snap.sharded_mat, jnp.asarray(qs_host), excl, lut_j,
                        snap.sharded_buckets)
@@ -419,15 +428,21 @@ class ALSServingModel(ServingModel):
                     return out[offset:offset + how_many]
                 k = min(snap.n, k * 2)  # widen: host filter consumed candidates
         q = jnp.asarray(q_host)
-        valid = self._candidate_mask(snap, q_host)
+        # unfiltered hot path stays exactly matmul + top_k: masks are None
+        # (static) unless LSH or exclusions actually apply
+        has_lsh = self.lsh is not None and snap.buckets is not None
+        valid = self._candidate_mask(snap, q_host) if has_lsh else None
+        excl = None
         if excluded:
             ix = [snap.id_to_idx[i] for i in excluded if i in snap.id_to_idx]
             if ix:
-                valid = valid.at[jnp.asarray(ix, dtype=jnp.int32)].set(False)
+                excl = jnp.asarray(np.asarray(ix, dtype=np.int32)[None, :])
         k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
         while True:
-            vals, idx = _top_k_dot(snap.score_mat, q, valid, k)
-            out = self._collect(snap, np.asarray(vals), np.asarray(idx), want, allowed, rescore)
+            vals, idx = _top_k_dot_batch(snap.score_mat, q[None, :], valid, excl, k)
+            out = self._collect(
+                snap, np.asarray(vals)[0], np.asarray(idx)[0], want, allowed, rescore
+            )
             if len(out) >= want or k >= snap.n:
                 return out[offset:offset + how_many]
             k = min(snap.n, k * 2)  # widen if filtering consumed candidates
@@ -459,14 +474,18 @@ class ALSServingModel(ServingModel):
                 for b in range(len(query_vecs))
             ]
         qs = jnp.asarray(qs_host)
-        excl = jnp.asarray(self._excluded_indices(snap, excluded, len(qs_host)))
+        use_excl = excluded is not None and any(e for e in excluded)
+        excl = (
+            jnp.asarray(self._excluded_indices(snap, excluded, len(qs_host)))
+            if use_excl
+            else None
+        )
         if self.lsh is None or snap.buckets is None:
-            valid = jnp.ones(snap.n, dtype=bool)
             k = min(
                 snap.n,
                 _round_up_pow2(max(2 * how_many, 64) if filtering else max(how_many, 16)),
             )
-            vals, idx = _top_k_dot_batch(snap.score_mat, qs, valid, excl, k)
+            vals, idx = _top_k_dot_batch(snap.score_mat, qs, None, excl, k)
         else:
             # per-query LSH candidate masks: (B, num_buckets) lookup table
             # indexed by item bucket on device
@@ -590,6 +609,11 @@ class ALSServingModelManager(AbstractServingModelManager):
         super().__init__(config)
         self.sample_rate = config.get_float("oryx.als.sample-rate")
         self.min_model_load_fraction = config.get_float("oryx.serving.min-model-load-fraction")
+        # opportunistic YᵀY pre-trigger once the model is loaded enough, so
+        # the first fold-in request doesn't stall on the factorization
+        # (ALSServingModelManager.java:95-105); rate-limited like the
+        # reference's test-and-trigger
+        self._solver_trigger_rate = RateLimitCheck(5)
         self.model: ALSServingModel | None = None
         self.rescorer_provider = load_rescorer_providers(config)
         self.mesh = None
@@ -619,6 +643,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                 self.model.set_item_vector(id_, vec)
             else:
                 raise ValueError(f"bad update type: {kind}")
+            self._maybe_trigger_solvers()
         elif key in ("MODEL", "MODEL-REF"):
             pmml = read_pmml_from_update_key_message(key, message)
             meta = pmml_codec.pmml_to_meta(pmml)
@@ -637,5 +662,18 @@ class ALSServingModelManager(AbstractServingModelManager):
                 m.retain_recent_and_known_items(meta["x_ids"])
                 m.expected_user_ids = set(meta["x_ids"]) - set(m.x.ids())
                 m.expected_item_ids = set(meta["y_ids"]) - set(m.y.ids())
+            self._maybe_trigger_solvers()  # MODEL alone may cross the threshold
         else:
             raise ValueError(f"bad key: {key}")
+
+    def _maybe_trigger_solvers(self) -> None:
+        """Kick the async YᵀY factorization once the model passes the load
+        fraction, so the first /estimateForAnonymous doesn't stall on it
+        (ALSServingModelManager.java:95-105). Rate-limited: the fraction test
+        walks the expected-ID sets, too costly per UP message; the launch
+        itself is a no-op when the cache is clean (single-flight dirty flag),
+        so later UPs re-warm naturally."""
+        if self.model is None or not self._solver_trigger_rate.test():
+            return
+        if self.model.get_fraction_loaded() >= self.min_model_load_fraction:
+            self.model.precompute_solvers()

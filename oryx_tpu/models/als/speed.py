@@ -82,6 +82,9 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         self.log_strength = config.get_bool("oryx.als.logStrength")
         self.epsilon = config.get_float("oryx.als.hyperparams.epsilon")
         self.min_model_load_fraction = config.get_float("oryx.speed.min-model-load-fraction")
+        # ALSSpeedModelManager.java:223-231: updates carry the interaction's
+        # other ID so serving can track known items live, unless disabled
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.model: ALSSpeedModel | None = None
         self._log_rate = RateLimitCheck(60)
 
@@ -171,10 +174,17 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
                 xtx_solver, values, yis, has_yi, xus, has_xu, self.implicit
             )
 
+        # wire format [matrix, ID, vector, [otherID]] — the 4th element feeds
+        # serving's known-items live (ALSSpeedModelManager.java:223-231);
+        # omitted entirely under oryx.als.no-known-items
         updates: list[str] = []
         for b, ((user, item), _) in enumerate(pairs):
             if new_x is not None and changed_x[b]:
-                updates.append(json.dumps(["X", user, [float(v) for v in new_x[b]]]))
+                vec = [float(v) for v in new_x[b]]
+                up = ["X", user, vec] if self.no_known_items else ["X", user, vec, [item]]
+                updates.append(json.dumps(up))
             if new_y is not None and changed_y[b]:
-                updates.append(json.dumps(["Y", item, [float(v) for v in new_y[b]]]))
+                vec = [float(v) for v in new_y[b]]
+                up = ["Y", item, vec] if self.no_known_items else ["Y", item, vec, [user]]
+                updates.append(json.dumps(up))
         return updates

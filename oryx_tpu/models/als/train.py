@@ -1,32 +1,40 @@
-"""TPU-native ALS training kernel.
+"""TPU-native ALS training kernel — block-partitioned normal equations.
 
 Replaces Spark MLlib's distributed ALS (behind ALSUpdate.buildModel,
 app/oryx-app-mllib/.../als/ALSUpdate.java:108-179) with a jit'd JAX program
-designed for the MXU:
+designed for the MXU, with *memory-bounded* block solves — the same property
+that lets MLlib's block-partitioned ALS (ALSUpdate.java:141-152) train
+2M–21M-row models without materializing every per-row Gramian at once:
 
   * implicit feedback à la Hu/Koren/Volinsky as in MLlib: confidence
     c = 1 + α·|r|, preference p = 1 if r > 0 else 0; explicit = ALS-WR with
     λ·n_u regularization scaling;
-  * per-side normal equations are accumulated by scanning fixed-size nnz
-    chunks: gather factor rows, form weighted outer products (C,k,k), and
-    scatter-add into the per-row Gramian buffer with a sorted segment-sum —
-    O(nnz·k²) work, chunk-bounded memory;
-  * all rows solve in one batched Cholesky (jax.scipy cho_factor/cho_solve
-    over (n_rows,k,k)) — the MXU-friendly replacement for MLlib's per-block
-    LAPACK calls;
-  * under a mesh, the row dimension of the Gramian/factor buffers shards over
-    devices (sharding annotations; XLA inserts the scatter/gather collectives)
-    while the opposite-side factor matrix is replicated per half-iteration —
+  * interactions are sorted by row host-side and split into **row blocks**
+    of B rows each; because the COO is row-sorted, each block owns a
+    contiguous nnz slice, padded to one uniform length L so every block is
+    the same static shape (XLA: one trace, no dynamic shapes);
+  * one block solve = scan the block's nnz in fixed-size chunks, gather the
+    opposite factors, form weighted outer products, and accumulate into a
+    (B+1, k, k) Gramian via a **sorted segment-sum** — peak memory
+    O(B·k² + C·k²), never O(n_rows·k²) — then a single batched Cholesky
+    (cho_factor/cho_solve over (B, k, k)), the MXU-friendly replacement for
+    MLlib's per-block LAPACK calls;
+  * under a mesh the **block axis shards over devices** via shard_map: each
+    device lax.map's its local blocks with the opposite-side factors
+    replicated, and the half-iteration's output factors come back
+    row-partitioned (out_specs pins the sharding — XLA inserts the
+    all-gather when the next half-iteration needs them replicated). This is
     the classic alternating block layout of distributed ALS.
 
 Interactions must arrive sorted by row (data.build_rating_batch guarantees
-it); both row-sorted and column-sorted copies are kept so each half-iteration
-scans its natural order.
+it); both row-sorted and column-sorted blocked copies are built once and
+reused across iterations.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 from dataclasses import dataclass
 
 import jax
@@ -37,87 +45,137 @@ from oryx_tpu.models.als.data import RatingBatch
 
 DEFAULT_NNZ_CHUNK = 16384
 
+# Budgets (in f32 elements) bounding the two big transients: the per-block
+# Gramian carry (B+1, k, k) and the per-chunk outer-product buffer (C, k, k).
+_BLOCK_ELEM_BUDGET = 1 << 26  # 256 MB carry
+_CHUNK_ELEM_BUDGET = 1 << 24  # 64 MB transient
 
-def _pad_to_multiple(arr: np.ndarray, multiple: int, fill) -> np.ndarray:
-    n = len(arr)
-    rem = (-n) % multiple
-    if rem == 0:
-        return arr
-    return np.concatenate([arr, np.full(rem, fill, dtype=arr.dtype)])
+
+def _auto_block(features: int) -> int:
+    return max(512, min(8192, _BLOCK_ELEM_BUDGET // (features * features)))
+
+
+def _auto_chunk(features: int) -> int:
+    return max(256, min(8192, _CHUNK_ELEM_BUDGET // (features * features)))
 
 
 @dataclass
-class _SideArrays:
-    """Device-ready COO for one half-iteration, padded to the chunk size;
-    padding rows point at the spill row (index n_rows) with zero weight."""
+class _BlockedSide:
+    """Device-ready blocked COO for one half-iteration.
 
-    rows: jnp.ndarray
-    cols: jnp.ndarray
-    vals: jnp.ndarray
+    ``rows`` holds block-LOCAL row indices in [0, block]; ``block`` is the
+    spill row (padding), weight-zeroed in the solve. Each block's entries are
+    the contiguous row-sorted slice of the global COO that falls in its row
+    range, right-padded to the uniform length L (a multiple of chunk).
+    """
+
+    rows: jnp.ndarray  # (n_blocks, L) int32
+    cols: jnp.ndarray  # (n_blocks, L) int32
+    vals: jnp.ndarray  # (n_blocks, L) float32 (0 = padding)
+    n_rows: int
+    block: int
+    n_blocks: int
+
+    @property
+    def padded_rows(self) -> int:
+        return self.n_blocks * self.block
 
 
-def _make_side(rows, cols, vals, n_rows: int, chunk: int) -> _SideArrays:
-    order = np.argsort(rows, kind="stable")
-    r = _pad_to_multiple(rows[order].astype(np.int32), chunk, n_rows)
-    c = _pad_to_multiple(cols[order].astype(np.int32), chunk, 0)
-    v = _pad_to_multiple(vals[order].astype(np.float32), chunk, 0.0)
-    return _SideArrays(jnp.asarray(r), jnp.asarray(c), jnp.asarray(v))
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_rows", "features", "implicit", "chunk"),
-)
-def solve_side(
-    factors,  # (n_cols, k) opposite-side factors
-    rows,  # (nnz_padded,) int32 sorted
-    cols,  # (nnz_padded,) int32
-    vals,  # (nnz_padded,) float32 (0 = padding)
+def make_blocked_side(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
     n_rows: int,
-    features: int,
-    lam: float,
-    alpha: float,
-    implicit: bool,
-    chunk: int = DEFAULT_NNZ_CHUNK,
-):
-    """One half-iteration: solve all row factors against fixed column factors."""
+    block: int,
+    chunk: int,
+    n_block_multiple: int = 1,
+) -> _BlockedSide:
+    """Host-side blocked-COO construction (row-sorted → contiguous slices)."""
+    order = np.argsort(rows, kind="stable")
+    r = rows[order].astype(np.int64)
+    c = cols[order].astype(np.int32)
+    v = vals[order].astype(np.float32)
+    n_blocks = max(1, -(-n_rows // block))
+    n_blocks = -(-n_blocks // n_block_multiple) * n_block_multiple
+    bounds = np.searchsorted(r, np.arange(n_blocks + 1, dtype=np.int64) * block)
+    lens = np.diff(bounds)
+    max_len = int(lens.max()) if len(r) else 0
+    length = max(chunk, -(-max(max_len, 1) // chunk) * chunk)
+    # Every block pads to the largest block's nnz, so a hot row range inflates
+    # memory AND scan work for all blocks. Power-law data can hit this; make
+    # the blowup visible rather than silent (a hot SINGLE row cannot be split
+    # in this formulation — splitting would need two-level partial-Gramian
+    # merging; revisit if real data trips this).
+    if len(r) and n_blocks > 1:
+        pad_ratio = length * n_blocks / max(1, len(r))
+        if pad_ratio > 4.0:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "blocked COO padding ratio %.1fx (max block %d nnz vs %.0f "
+                "mean): row-skewed data; consider a smaller block size",
+                pad_ratio, max_len, len(r) / n_blocks,
+            )
+    brows = np.full((n_blocks, length), block, dtype=np.int32)
+    bcols = np.zeros((n_blocks, length), dtype=np.int32)
+    bvals = np.zeros((n_blocks, length), dtype=np.float32)
+    for j in range(n_blocks):
+        s, e = bounds[j], bounds[j + 1]
+        if e > s:
+            brows[j, : e - s] = (r[s:e] - j * block).astype(np.int32)
+            bcols[j, : e - s] = c[s:e]
+            bvals[j, : e - s] = v[s:e]
+    return _BlockedSide(
+        jnp.asarray(brows), jnp.asarray(bcols), jnp.asarray(bvals),
+        n_rows, block, n_blocks,
+    )
+
+
+def _solve_block(y, rows, cols, vals, *, block, features, lam, alpha,
+                 implicit, chunk, yty):
+    """Solve one row block's factors against fixed column factors ``y``.
+
+    rows: (L,) block-local int32 in [0, block] (block = spill/padding);
+    returns (block, k). Peak memory O(block·k² + chunk·k²).
+    """
     k = features
-    nnz = rows.shape[0]
-    n_chunks = nnz // chunk
+    n_chunks = rows.shape[0] // chunk
 
     def body(carry, i):
         big_a, big_b, cnt = carry
         sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * chunk, chunk)
         r, c, v = sl(rows), sl(cols), sl(vals)
-        yg = factors[c]  # (C, k) gather
+        yg = y[c]  # (C, k) gather of the replicated opposite side
         if implicit:
             w = alpha * jnp.abs(v)  # confidence - 1
             pref = (v > 0).astype(jnp.float32)
             b_contrib = ((1.0 + w) * pref)[:, None] * yg
         else:
-            w = jnp.ones_like(v)  # padding zeroed by pad_mask below
+            w = jnp.ones_like(v)  # padding zeroed by pad mask below
             b_contrib = v[:, None] * yg
-        pad_mask = (r < n_rows).astype(jnp.float32)
-        w = w * pad_mask
-        outer = (yg[:, :, None] * yg[:, None, :]) * w[:, None, None]  # (C, k, k)
-        big_a = big_a.at[r].add(outer)
-        big_b = big_b.at[r].add(b_contrib * pad_mask[:, None])
-        cnt = cnt.at[r].add(pad_mask)
+        pad = (r < block).astype(jnp.float32)
+        w = w * pad
+        outer = (yg[:, :, None] * yg[:, None, :]) * w[:, None, None]  # (C,k,k)
+        seg = functools.partial(
+            jax.ops.segment_sum, num_segments=block + 1, indices_are_sorted=True
+        )
+        big_a = big_a + seg(outer, r)
+        big_b = big_b + seg(b_contrib * pad[:, None], r)
+        cnt = cnt + seg(pad, r)
         return (big_a, big_b, cnt), None
 
-    big_a = jnp.zeros((n_rows + 1, k, k), dtype=jnp.float32)
-    big_b = jnp.zeros((n_rows + 1, k), dtype=jnp.float32)
-    cnt = jnp.zeros((n_rows + 1,), dtype=jnp.float32)
-    (big_a, big_b, cnt), _ = jax.lax.scan(
-        body, (big_a, big_b, cnt), jnp.arange(n_chunks)
+    init = (
+        jnp.zeros((block + 1, k, k), dtype=jnp.float32),
+        jnp.zeros((block + 1, k), dtype=jnp.float32),
+        jnp.zeros((block + 1,), dtype=jnp.float32),
     )
-    big_a, big_b, cnt = big_a[:n_rows], big_b[:n_rows], cnt[:n_rows]
+    (big_a, big_b, cnt), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    big_a, big_b, cnt = big_a[:block], big_b[:block], cnt[:block]
 
     eye = jnp.eye(k, dtype=jnp.float32)
     # ALS-WR regularization scaling by interaction count (MLlib semantics)
     reg = lam * jnp.maximum(cnt, 1.0)
     if implicit:
-        yty = factors.T @ factors  # (k, k) Gramian — one MXU matmul
         big_a = big_a + yty[None, :, :]
     big_a = big_a + reg[:, None, None] * eye[None, :, :]
 
@@ -125,6 +183,64 @@ def solve_side(
     x = jax.scipy.linalg.cho_solve((chol, True), big_b[..., None])[..., 0]
     # rows with no interactions have no factor (reference: absent IDs)
     return jnp.where((cnt > 0)[:, None], x, 0.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "features", "implicit", "chunk")
+)
+def solve_side_blocked(y, brows, bcols, bvals, lam, alpha, *, block, features,
+                       implicit, chunk):
+    """One half-iteration, single device: lax.map over row blocks."""
+    yty = (y.T @ y) if implicit else None  # (k,k) Gramian — one MXU matmul
+
+    def one(args):
+        r, c, v = args
+        return _solve_block(
+            y, r, c, v, block=block, features=features, lam=lam, alpha=alpha,
+            implicit=implicit, chunk=chunk, yty=yty,
+        )
+
+    out = jax.lax.map(one, (brows, bcols, bvals))  # (n_blocks, block, k)
+    return out.reshape(-1, features)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_solver(mesh, row_axis, block, features, implicit, chunk):
+    """jit(shard_map) for one half-iteration: blocks shard over ``row_axis``,
+    opposite factors replicated, output factors row-partitioned (pinned by
+    out_specs). Cached per (mesh, statics)."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
+    def local(y, brows, bcols, bvals, lam, alpha):
+        yty = (y.T @ y) if implicit else None
+
+        def one(args):
+            r, c, v = args
+            return _solve_block(
+                y, r, c, v, block=block, features=features, lam=lam,
+                alpha=alpha, implicit=implicit, chunk=chunk, yty=yty,
+            )
+
+        out = jax.lax.map(one, (brows, bcols, bvals))
+        return out.reshape(-1, features)
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(), P(row_axis), P(row_axis), P(row_axis), P(), P()),
+        out_specs=P(row_axis),
+    )
+    # scan carries are block-local, not replicated: disable the varying-axis
+    # check (kwarg renamed check_rep -> check_vma in jax 0.8)
+    try:
+        sm = shard_map(local, check_vma=False, **specs)
+    except TypeError:  # pragma: no cover — older jax
+        sm = shard_map(local, check_rep=False, **specs)
+    return jax.jit(sm)
 
 
 def als_train(
@@ -135,40 +251,85 @@ def als_train(
     implicit: bool,
     iterations: int = 10,
     key=None,
-    chunk: int = DEFAULT_NNZ_CHUNK,
+    chunk: int | None = None,
     mesh=None,
     row_axis: str | None = None,
+    block: int | None = None,
 ):
     """Full alternating optimization; returns (X, Y) as jax arrays.
 
-    With ``mesh``/``row_axis`` given, factor and Gramian buffers are sharded
-    over rows of the side being solved (NamedSharding); without, single-device.
+    Single-device (no mesh): returns exact-shape ``(n_users, k)``/
+    ``(n_items, k)`` arrays.
+
+    With ``mesh``/``row_axis``: the block axis shards over that mesh axis on
+    the way in (device_put) and the way out (shard_map out_specs pins the
+    factors row-partitioned), and the returned factors are **padded up to the
+    block boundary** (``shape[0] = n_blocks·block ≥ n_rows``, extra rows
+    zero) — exact-size uneven shardings are not expressible, and gathering
+    to slice would defeat the partitioning. Consumers slice host-side
+    (``np.asarray(x)[:n_users]``). ``block``/``chunk`` default to sizes
+    bounding device memory at ~256 MB / ~64 MB regardless of n_rows; block
+    is chosen per side so a small side is not over-padded.
     """
     from oryx_tpu.common import rand
 
     n_users, n_items = len(batch.users), len(batch.items)
+    k = features
+    ndev = 1
+    if mesh is not None and row_axis is not None:
+        ndev = mesh.shape[row_axis]
+    if chunk is None:
+        chunk = _auto_chunk(k)
+    auto = _auto_block(k) if block is None else block
+    # keep every device busy: no point in blocks wider than a device's share
+    block_u = max(32, min(auto, -(-n_users // ndev)))
+    block_i = max(32, min(auto, -(-n_items // ndev)))
+
+    user_side = make_blocked_side(
+        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk, ndev
+    )
+    item_side = make_blocked_side(
+        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk, ndev
+    )
+
     if key is None:
         key = rand.get_key()
     k1, _ = jax.random.split(key)
-    y = 0.1 * jax.random.normal(k1, (n_items, features), dtype=jnp.float32)
-
-    user_side = _make_side(batch.rows, batch.cols, batch.vals, n_users, chunk)
-    item_side = _make_side(batch.cols, batch.rows, batch.vals, n_items, chunk)
+    y0 = 0.1 * jax.random.normal(k1, (n_items, k), dtype=jnp.float32)
+    # padded factor buffers: gathers only ever index real rows (< n_cols),
+    # so padding rows are never read
+    y = jnp.zeros((item_side.padded_rows, k), dtype=jnp.float32).at[:n_items].set(y0)
 
     if mesh is not None and row_axis is not None:
-        row_sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(row_axis)
-        )
-        y = jax.device_put(y, row_sharding)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row_shard = NamedSharding(mesh, P(row_axis, None))
+
+        def put_side(side):
+            return tuple(
+                jax.device_put(a, NamedSharding(mesh, P(row_axis, None)))
+                for a in (side.rows, side.cols, side.vals)
+            )
+
+        u_arrays = put_side(user_side)
+        i_arrays = put_side(item_side)
+        y = jax.device_put(y, row_shard)
+        solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit, chunk)
+        solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit, chunk)
+        x = None
+        for _ in range(iterations):
+            x = solve_u(y, *u_arrays, lam, alpha)
+            y = solve_i(x, *i_arrays, lam, alpha)
+        return x, y
 
     x = None
     for _ in range(iterations):
-        x = solve_side(
-            y, user_side.rows, user_side.cols, user_side.vals,
-            n_users, features, lam, alpha, implicit, chunk,
+        x = solve_side_blocked(
+            y, user_side.rows, user_side.cols, user_side.vals, lam, alpha,
+            block=block_u, features=k, implicit=implicit, chunk=chunk,
         )
-        y = solve_side(
-            x, item_side.rows, item_side.cols, item_side.vals,
-            n_items, features, lam, alpha, implicit, chunk,
+        y = solve_side_blocked(
+            x, item_side.rows, item_side.cols, item_side.vals, lam, alpha,
+            block=block_i, features=k, implicit=implicit, chunk=chunk,
         )
-    return x, y
+    return x[:n_users], y[:n_items]

@@ -89,9 +89,11 @@ class ALSUpdate(MLUpdate):
             mesh=mesh,
             row_axis=row_axis,
         )
+        # mesh-path factors come back row-partitioned and padded to the block
+        # boundary (train.als_train contract) — slice to exact size host-side
         return pmml_codec.model_to_pmml(
-            np.asarray(x),
-            np.asarray(y),
+            np.asarray(x)[: len(batch.users)],
+            np.asarray(y)[: len(batch.items)],
             batch.users.index_to_id,
             batch.items.index_to_id,
             features,

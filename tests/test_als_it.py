@@ -98,16 +98,47 @@ def test_full_als_lambda_loop(tmp_path):
         recs = model.top_n(uv, 4, allowed=lambda i: i not in known)
         assert len(recs) == 4 and known.isdisjoint({i for i, _ in recs})
 
-        # speed layer folds in new interactions and emits UPs beyond the batch's
+        # speed layer folds in new interactions and emits UPs beyond the batch's;
+        # pick an item u0 has NOT interacted with (fold-in needs an existing Yi).
+        # The batch layer is CLOSED first so everything below demonstrably
+        # flows through the speed tier alone — no batch build in between.
+        batch.close()
+        fresh_item = next(f"i{i}" for i in range(20) if f"i{i}" not in known)
         size_before = broker.size("OryxUpdate")
-        producer.send(None, f"u0,i19,1,{int(time.time() * 1000)}")
+        producer.send(None, f"u0,{fresh_item},1,{int(time.time() * 1000)}")
         deadline = time.monotonic() + 30
-        new_ups = []
-        while time.monotonic() < deadline and not new_ups:
+        x_up = None
+        while time.monotonic() < deadline and x_up is None:
             msgs2 = broker.read("OryxUpdate", size_before, 1000)
-            new_ups = [km for km in msgs2 if km.key == "UP"]
+            for km in msgs2:
+                if km.key == "UP":
+                    up = json.loads(km.message)
+                    if up[0] == "X" and up[1] == "u0":
+                        x_up = up
             time.sleep(0.1)
-        assert new_ups, "speed layer produced no fold-in updates"
+        assert x_up is not None, "speed layer produced no fold-in X update"
+
+        # speed-tier wire format carries the known-items element
+        # (ALSSpeedModelManager.java:223-231): [matrix, ID, vector, [otherID]]
+        assert len(x_up) == 4 and x_up[3] == [fresh_item]
+
+        # ... and serving reflects the interaction with NO batch build in
+        # between: known items + the updated user vector flow through live
+        uv_before = np.array(model.get_user_vector("u0"))
+        for km in broker.read("OryxUpdate", size_before, 1000):
+            if km.key == "UP":
+                serving_mgr.consume_key_message(km.key, km.message)
+        assert fresh_item in model.get_known_items("u0")
+        uv_after = np.array(model.get_user_vector("u0"))
+        assert not np.allclose(uv_before, uv_after)
+        # considerKnownItems=True (no exclusion) surfaces the fresh item among
+        # the 20 candidates; considerKnownItems=False (the default known-items
+        # exclusion, now including the speed-tier interaction) hides it
+        known_after = model.get_known_items("u0")
+        unfiltered = {i for i, _ in model.top_n(uv_after, 20)}
+        assert fresh_item in unfiltered
+        excl = model.top_n(uv_after, 20, allowed=lambda i: i not in known_after)
+        assert fresh_item not in {i for i, _ in excl}
     finally:
         serving_it.close()
         batch.close()

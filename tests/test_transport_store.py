@@ -217,24 +217,29 @@ def test_two_consumer_group_fanout(url, tmp_path):
         + tp.partitions_for_member("c2", ["c1", "c2"], 4)
     ) == [0, 1, 2, 3]
 
+    # The iterator is blocking by design (ConsumeDataIterator.java:30-77), so
+    # each consumer drains on its own thread; close() wakes them with
+    # StopIteration once everything has been seen.
     got1, got2 = [], []
+
+    def drain(it, got):
+        try:
+            for km in it:
+                got.append(km.message)
+        except Exception:  # noqa: BLE001 — surfaces via the count assert below
+            pass
+
+    t1 = threading.Thread(target=drain, args=(it1, got1), daemon=True)
+    t2 = threading.Thread(target=drain, args=(it2, got2), daemon=True)
+    t1.start()
+    t2.start()
     deadline = time.time() + 10
     while len(got1) + len(got2) < 60 and time.time() < deadline:
-        for it, got in ((it1, got1), (it2, got2)):
-            try:
-                before = len(got)
-                while True:
-                    got.append(next(it).message)
-                    if len(got) - before > 60:
-                        break
-            except StopIteration:
-                pass
-            # drain what is buffered without blocking forever: close after
-            break_on_empty = True
-        if len(got1) + len(got2) >= 60:
-            break
+        time.sleep(0.01)
     it1.close()
     it2.close()
+    t1.join(5)
+    t2.join(5)
     assert sorted(got1 + got2) == sorted(f"m{i}" for i in range(60))
     assert got1 and got2  # both consumers actually shared the work
     assert not (set(got1) & set(got2))  # no duplicates
