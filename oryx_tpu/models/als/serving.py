@@ -66,18 +66,32 @@ def _mask_excluded(scores, excl):
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _top_k_dot_batch(mat, qs, valid, excl, k: int):
-    """One MXU matmul for the whole query batch + approx top-k. ``valid`` /
-    ``excl`` are None on the unfiltered hot path so it stays exactly
-    matmul + top_k (None is a static pytree — XLA never sees a dummy mask;
-    the r1→r2 CPU regression was unconditional masking here).
+    """One MXU matmul for the whole query batch + approx top-k (the masking
+    logic lives once in ``_masked_scores``). ``valid`` / ``excl`` are None on
+    the unfiltered hot path so it stays exactly matmul + top_k (None is a
+    static pytree — XLA never sees a dummy mask; the r1→r2 CPU regression was
+    unconditional masking here).
 
     approx_max_k is the TPU-native top-k (recall ≥ 0.99 beats LSH 0.3's own
     approximation); exact on backends without the TPU op."""
-    scores = _score(qs, mat)  # (B, n)
+    return _top_k_of_scores(_masked_scores(mat, qs, valid, excl), k)
+
+
+@jax.jit
+def _masked_scores(mat, qs, valid, excl):
+    """Masked score matrix only — lets the widening retry in ``top_n`` reuse
+    one matmul's scores across successively larger top-k calls instead of
+    re-scanning Y each widening."""
+    scores = _score(qs, mat)
     if valid is not None:
         scores = jnp.where(valid[None, :], scores, -jnp.inf)
     if excl is not None:
         scores = _mask_excluded(scores, excl)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _top_k_of_scores(scores, k: int):
     return jax.lax.approx_max_k(scores, k, recall_target=0.99)
 
 
@@ -434,12 +448,17 @@ class ALSServingModel(ServingModel):
         valid = self._candidate_mask(snap, q_host) if has_lsh else None
         excl = None
         if excluded:
-            ix = [snap.id_to_idx[i] for i in excluded if i in snap.id_to_idx]
-            if ix:
-                excl = jnp.asarray(np.asarray(ix, dtype=np.int32)[None, :])
+            # pow2-padded with -1 fill (the batch helper at batch=1) so jit
+            # signatures stay stable: every distinct known-item count would
+            # otherwise trigger a fresh compile on the serving hot path
+            padded = self._excluded_indices(snap, [excluded], 1)
+            if (padded >= 0).any():
+                excl = jnp.asarray(padded)
+        # score once; widenings re-run only the top-k over the cached scores
+        scores = _masked_scores(snap.score_mat, q[None, :], valid, excl)
         k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
         while True:
-            vals, idx = _top_k_dot_batch(snap.score_mat, q[None, :], valid, excl, k)
+            vals, idx = _top_k_of_scores(scores, k)
             out = self._collect(
                 snap, np.asarray(vals)[0], np.asarray(idx)[0], want, allowed, rescore
             )

@@ -1,4 +1,4 @@
-"""TPU-native ALS training kernel — block-partitioned normal equations.
+"""TPU-native ALS training kernel — slot-padded block normal equations.
 
 Replaces Spark MLlib's distributed ALS (behind ALSUpdate.buildModel,
 app/oryx-app-mllib/.../als/ALSUpdate.java:108-179) with a jit'd JAX program
@@ -9,16 +9,21 @@ that lets MLlib's block-partitioned ALS (ALSUpdate.java:141-152) train
   * implicit feedback à la Hu/Koren/Volinsky as in MLlib: confidence
     c = 1 + α·|r|, preference p = 1 if r > 0 else 0; explicit = ALS-WR with
     λ·n_u regularization scaling;
-  * interactions are sorted by row host-side and split into **row blocks**
-    of B rows each; because the COO is row-sorted, each block owns a
-    contiguous nnz slice, padded to one uniform length L so every block is
-    the same static shape (XLA: one trace, no dynamic shapes);
-  * one block solve = scan the block's nnz in fixed-size chunks, gather the
-    opposite factors, form weighted outer products, and accumulate into a
-    (B+1, k, k) Gramian via a **sorted segment-sum** — peak memory
-    O(B·k² + C·k²), never O(n_rows·k²) — then a single batched Cholesky
-    (cho_factor/cho_solve over (B, k, k)), the MXU-friendly replacement for
-    MLlib's per-block LAPACK calls;
+  * interactions are sorted by row host-side and packed into fixed-width
+    **slots** of T entries each: a row with d interactions occupies
+    ceil(d/T) slots (Gramians are additive, so a hot row simply spans more
+    slots — no global padding blow-up from skew). Slots are grouped into
+    **row blocks** of B rows, padded to one uniform slot count S per block
+    (XLA: one trace, static shapes);
+  * one block solve = scan the block's slots in fixed-size chunks, gather
+    the opposite factors (Sc, T, k), and form per-slot Gramians with ONE
+    batched matmul — einsum('st,sti,stj->sij') → (Sc, k, k) — which is the
+    MXU-shaped formulation (contraction over the slot width T). Slots then
+    merge into per-row Gramians via a short sorted segment-sum over at most
+    Sc indices (k²-granularity scatter traffic is slots·k², ~mean-degree×
+    less than the naive nnz·k² outer-product scatter). Peak memory stays
+    O(B·k² + Sc·T·k); a single batched Cholesky (cho_factor/cho_solve over
+    (B, k, k)) replaces MLlib's per-block LAPACK calls;
   * under a mesh the **block axis shards over devices** via shard_map: each
     device lax.map's its local blocks with the opposite-side factors
     replicated, and the half-iteration's output factors come back
@@ -27,7 +32,7 @@ that lets MLlib's block-partitioned ALS (ALSUpdate.java:141-152) train
     the classic alternating block layout of distributed ALS.
 
 Interactions must arrive sorted by row (data.build_rating_batch guarantees
-it); both row-sorted and column-sorted blocked copies are built once and
+it); both row-sorted and column-sorted slotted copies are built once and
 reused across iterations.
 """
 
@@ -43,10 +48,9 @@ import numpy as np
 
 from oryx_tpu.models.als.data import RatingBatch
 
-DEFAULT_NNZ_CHUNK = 16384
-
 # Budgets (in f32 elements) bounding the two big transients: the per-block
-# Gramian carry (B+1, k, k) and the per-chunk outer-product buffer (C, k, k).
+# Gramian carry (B+1, k, k) and the per-chunk gather/Gramian buffers
+# (Sc, T, k) + (Sc, k, k).
 _BLOCK_ELEM_BUDGET = 1 << 26  # 256 MB carry
 _CHUNK_ELEM_BUDGET = 1 << 24  # 64 MB transient
 
@@ -55,26 +59,38 @@ def _auto_block(features: int) -> int:
     return max(512, min(8192, _BLOCK_ELEM_BUDGET // (features * features)))
 
 
-def _auto_chunk(features: int) -> int:
-    return max(256, min(8192, _CHUNK_ELEM_BUDGET // (features * features)))
+def _auto_slot_chunk(features: int, slot_width: int) -> int:
+    per_slot = max(slot_width * features, features * features)
+    return max(64, min(8192, _CHUNK_ELEM_BUDGET // per_slot))
+
+
+def _auto_slot_width(nnz: int, n_nonempty_rows: int) -> int:
+    """Slot width T ≈ mean row degree, as a power of two in [8, 512]."""
+    mean = nnz / max(1, n_nonempty_rows)
+    t = 1 << max(0, math.ceil(math.log2(max(1.0, mean))))
+    return max(8, min(512, t))
 
 
 @dataclass
 class _BlockedSide:
-    """Device-ready blocked COO for one half-iteration.
+    """Device-ready slotted COO for one half-iteration.
 
-    ``rows`` holds block-LOCAL row indices in [0, block]; ``block`` is the
-    spill row (padding), weight-zeroed in the solve. Each block's entries are
-    the contiguous row-sorted slice of the global COO that falls in its row
-    range, right-padded to the uniform length L (a multiple of chunk).
+    ``srows`` holds block-LOCAL row indices in [0, block]; ``block`` is the
+    spill row (slot padding), length-zeroed in the solve. Each block's slots
+    are the contiguous row-sorted run of the global slot list that falls in
+    its row range, right-padded to the uniform count S (a multiple of the
+    scan chunk).
     """
 
-    rows: jnp.ndarray  # (n_blocks, L) int32
-    cols: jnp.ndarray  # (n_blocks, L) int32
-    vals: jnp.ndarray  # (n_blocks, L) float32 (0 = padding)
+    srows: jnp.ndarray  # (n_blocks, S) int32, pad = block
+    scols: jnp.ndarray  # (n_blocks, S, T) int32
+    svals: jnp.ndarray  # (n_blocks, S, T) float32
+    slens: jnp.ndarray  # (n_blocks, S) int32 valid entries per slot (0 = pad)
     n_rows: int
     block: int
     n_blocks: int
+    slot_width: int
+    slot_chunk: int
 
     @property
     def padded_rows(self) -> int:
@@ -87,81 +103,126 @@ def make_blocked_side(
     vals: np.ndarray,
     n_rows: int,
     block: int,
-    chunk: int,
+    slot_chunk: int | None,
+    slot_width: int | None,
     n_block_multiple: int = 1,
+    features: int | None = None,
 ) -> _BlockedSide:
-    """Host-side blocked-COO construction (row-sorted → contiguous slices)."""
+    """Host-side slotted-COO construction (row-sorted → contiguous slots).
+
+    ``slot_width=None`` picks T from the side's mean row degree (one degree
+    histogram, reused for the slot layout); ``slot_chunk=None`` then sizes
+    the scan chunk from T and ``features`` to stay inside the transient
+    budget."""
     order = np.argsort(rows, kind="stable")
     r = rows[order].astype(np.int64)
     c = cols[order].astype(np.int32)
     v = vals[order].astype(np.float32)
     n_blocks = max(1, -(-n_rows // block))
     n_blocks = -(-n_blocks // n_block_multiple) * n_block_multiple
-    bounds = np.searchsorted(r, np.arange(n_blocks + 1, dtype=np.int64) * block)
-    lens = np.diff(bounds)
-    max_len = int(lens.max()) if len(r) else 0
-    length = max(chunk, -(-max(max_len, 1) // chunk) * chunk)
-    # Every block pads to the largest block's nnz, so a hot row range inflates
-    # memory AND scan work for all blocks. Power-law data can hit this; make
-    # the blowup visible rather than silent (a hot SINGLE row cannot be split
-    # in this formulation — splitting would need two-level partial-Gramian
-    # merging; revisit if real data trips this).
+    padded_rows = n_blocks * block
+
+    deg = np.bincount(r, minlength=padded_rows) if len(r) else np.zeros(
+        padded_rows, dtype=np.int64
+    )
+    if slot_width is None:
+        slot_width = _auto_slot_width(len(r), int(np.count_nonzero(deg)))
+    t = slot_width
+    budget_max = _auto_slot_chunk(features or 32, t)
+    # explicit values are still clamped into the transient budget: a chunk
+    # tuned in nnz terms (each slot is T entries wide) must not OOM the device
+    slot_chunk = budget_max if slot_chunk is None else max(
+        16, min(slot_chunk, budget_max)
+    )
+    nslots_row = -(-deg // t)  # ceil; 0 slots for empty rows
+    row_slot_start = np.zeros(padded_rows + 1, dtype=np.int64)
+    np.cumsum(nslots_row, out=row_slot_start[1:])
+    row_entry_start = np.zeros(padded_rows + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_entry_start[1:])
+    total_slots = int(row_slot_start[-1])
+
+    scols_f = np.zeros((total_slots, t), dtype=np.int32)
+    svals_f = np.zeros((total_slots, t), dtype=np.float32)
+    if len(r):
+        p = np.arange(len(r), dtype=np.int64) - row_entry_start[r]
+        slot = row_slot_start[r] + p // t
+        pos = p % t
+        scols_f[slot, pos] = c
+        svals_f[slot, pos] = v
+        slens_f = np.bincount(slot, minlength=total_slots).astype(np.int32)
+    else:
+        slens_f = np.zeros(total_slots, dtype=np.int32)
+    srow_f = np.repeat(np.arange(padded_rows, dtype=np.int64), nslots_row)
+
+    sblock = srow_f // block
+    bounds = np.searchsorted(sblock, np.arange(n_blocks + 1, dtype=np.int64))
+    max_s = int(np.diff(bounds).max()) if total_slots else 0
+    s_len = max(slot_chunk, -(-max(max_s, 1) // slot_chunk) * slot_chunk)
+
+    # Slot packing bounds skew damage (a hot row just spans more slots), but
+    # uneven *block* slot counts still pad every block to the fullest one;
+    # surface a pathological ratio rather than hiding it.
     if len(r) and n_blocks > 1:
-        pad_ratio = length * n_blocks / max(1, len(r))
-        if pad_ratio > 4.0:
+        pad_ratio = s_len * t * n_blocks / max(1, len(r))
+        if pad_ratio > 6.0:
             import logging
 
             logging.getLogger(__name__).warning(
-                "blocked COO padding ratio %.1fx (max block %d nnz vs %.0f "
-                "mean): row-skewed data; consider a smaller block size",
-                pad_ratio, max_len, len(r) / n_blocks,
+                "slotted COO padding ratio %.1fx (T=%d, S=%d x %d blocks vs "
+                "%d nnz): row-skewed data; consider a smaller block size",
+                pad_ratio, t, s_len, n_blocks, len(r),
             )
-    brows = np.full((n_blocks, length), block, dtype=np.int32)
-    bcols = np.zeros((n_blocks, length), dtype=np.int32)
-    bvals = np.zeros((n_blocks, length), dtype=np.float32)
-    for j in range(n_blocks):
-        s, e = bounds[j], bounds[j + 1]
-        if e > s:
-            brows[j, : e - s] = (r[s:e] - j * block).astype(np.int32)
-            bcols[j, : e - s] = c[s:e]
-            bvals[j, : e - s] = v[s:e]
+
+    srows = np.full((n_blocks, s_len), block, dtype=np.int32)
+    scols = np.zeros((n_blocks, s_len, t), dtype=np.int32)
+    svals = np.zeros((n_blocks, s_len, t), dtype=np.float32)
+    slens = np.zeros((n_blocks, s_len), dtype=np.int32)
+    if total_slots:
+        sidx = np.arange(total_slots, dtype=np.int64) - bounds[sblock]
+        srows[sblock, sidx] = (srow_f - sblock * block).astype(np.int32)
+        scols[sblock, sidx] = scols_f
+        svals[sblock, sidx] = svals_f
+        slens[sblock, sidx] = slens_f
     return _BlockedSide(
-        jnp.asarray(brows), jnp.asarray(bcols), jnp.asarray(bvals),
-        n_rows, block, n_blocks,
+        jnp.asarray(srows), jnp.asarray(scols), jnp.asarray(svals),
+        jnp.asarray(slens), n_rows, block, n_blocks, t, slot_chunk,
     )
 
 
-def _solve_block(y, rows, cols, vals, *, block, features, lam, alpha,
-                 implicit, chunk, yty):
+def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
+                 implicit, slot_chunk, yty):
     """Solve one row block's factors against fixed column factors ``y``.
 
-    rows: (L,) block-local int32 in [0, block] (block = spill/padding);
-    returns (block, k). Peak memory O(block·k² + chunk·k²).
+    srow: (S,) block-local int32 in [0, block] (block = spill/padding);
+    scols/svals: (S, T); returns (block, k). Peak memory
+    O(block·k² + slot_chunk·T·k).
     """
     k = features
-    n_chunks = rows.shape[0] // chunk
+    t = scols.shape[-1]
+    n_chunks = srow.shape[0] // slot_chunk
 
     def body(carry, i):
         big_a, big_b, cnt = carry
-        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * chunk, chunk)
-        r, c, v = sl(rows), sl(cols), sl(vals)
-        yg = y[c]  # (C, k) gather of the replicated opposite side
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * slot_chunk, slot_chunk)
+        rs, ls = sl(srow), sl(slens)
+        cs, vs = sl(scols), sl(svals)
+        m = (jnp.arange(t)[None, :] < ls[:, None]).astype(jnp.float32)  # (Sc,T)
+        yg = y[cs]  # (Sc, T, k) gather of the replicated opposite side
         if implicit:
-            w = alpha * jnp.abs(v)  # confidence - 1
-            pref = (v > 0).astype(jnp.float32)
-            b_contrib = ((1.0 + w) * pref)[:, None] * yg
+            w = alpha * jnp.abs(vs) * m  # confidence - 1
+            coef = (1.0 + w) * (vs > 0).astype(jnp.float32) * m
         else:
-            w = jnp.ones_like(v)  # padding zeroed by pad mask below
-            b_contrib = v[:, None] * yg
-        pad = (r < block).astype(jnp.float32)
-        w = w * pad
-        outer = (yg[:, :, None] * yg[:, None, :]) * w[:, None, None]  # (C,k,k)
+            w = m
+            coef = vs * m
+        # per-slot Gramian: ONE batched MXU matmul, contraction over T
+        ga = jnp.einsum("st,sti,stj->sij", w, yg, yg)  # (Sc, k, k)
+        gb = jnp.einsum("st,sti->si", coef, yg)  # (Sc, k)
         seg = functools.partial(
             jax.ops.segment_sum, num_segments=block + 1, indices_are_sorted=True
         )
-        big_a = big_a + seg(outer, r)
-        big_b = big_b + seg(b_contrib * pad[:, None], r)
-        cnt = cnt + seg(pad, r)
+        big_a = big_a + seg(ga, rs)
+        big_b = big_b + seg(gb, rs)
+        cnt = cnt + seg(m.sum(-1), rs)
         return (big_a, big_b, cnt), None
 
     init = (
@@ -186,26 +247,26 @@ def _solve_block(y, rows, cols, vals, *, block, features, lam, alpha,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "features", "implicit", "chunk")
+    jax.jit, static_argnames=("block", "features", "implicit", "slot_chunk")
 )
-def solve_side_blocked(y, brows, bcols, bvals, lam, alpha, *, block, features,
-                       implicit, chunk):
+def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
+                       features, implicit, slot_chunk):
     """One half-iteration, single device: lax.map over row blocks."""
     yty = (y.T @ y) if implicit else None  # (k,k) Gramian — one MXU matmul
 
     def one(args):
-        r, c, v = args
+        r, c, v, ln = args
         return _solve_block(
-            y, r, c, v, block=block, features=features, lam=lam, alpha=alpha,
-            implicit=implicit, chunk=chunk, yty=yty,
+            y, r, c, v, ln, block=block, features=features, lam=lam,
+            alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
         )
 
-    out = jax.lax.map(one, (brows, bcols, bvals))  # (n_blocks, block, k)
+    out = jax.lax.map(one, (srows, scols, svals, slens))  # (n_blocks, block, k)
     return out.reshape(-1, features)
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_solver(mesh, row_axis, block, features, implicit, chunk):
+def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk):
     """jit(shard_map) for one half-iteration: blocks shard over ``row_axis``,
     opposite factors replicated, output factors row-partitioned (pinned by
     out_specs). Cached per (mesh, statics)."""
@@ -216,22 +277,23 @@ def _sharded_solver(mesh, row_axis, block, features, implicit, chunk):
     except ImportError:  # pragma: no cover — older jax
         from jax.experimental.shard_map import shard_map
 
-    def local(y, brows, bcols, bvals, lam, alpha):
+    def local(y, srows, scols, svals, slens, lam, alpha):
         yty = (y.T @ y) if implicit else None
 
         def one(args):
-            r, c, v = args
+            r, c, v, ln = args
             return _solve_block(
-                y, r, c, v, block=block, features=features, lam=lam,
-                alpha=alpha, implicit=implicit, chunk=chunk, yty=yty,
+                y, r, c, v, ln, block=block, features=features, lam=lam,
+                alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
             )
 
-        out = jax.lax.map(one, (brows, bcols, bvals))
+        out = jax.lax.map(one, (srows, scols, svals, slens))
         return out.reshape(-1, features)
 
     specs = dict(
         mesh=mesh,
-        in_specs=(P(), P(row_axis), P(row_axis), P(row_axis), P(), P()),
+        in_specs=(P(), P(row_axis), P(row_axis), P(row_axis), P(row_axis),
+                  P(), P()),
         out_specs=P(row_axis),
     )
     # scan carries are block-local, not replicated: disable the varying-axis
@@ -255,6 +317,7 @@ def als_train(
     mesh=None,
     row_axis: str | None = None,
     block: int | None = None,
+    slot_width: int | None = None,
 ):
     """Full alternating optimization; returns (X, Y) as jax arrays.
 
@@ -269,28 +332,35 @@ def als_train(
     to slice would defeat the partitioning. Consumers slice host-side
     (``np.asarray(x)[:n_users]``). ``block``/``chunk`` default to sizes
     bounding device memory at ~256 MB / ~64 MB regardless of n_rows; block
-    is chosen per side so a small side is not over-padded.
+    is chosen per side so a small side is not over-padded; the slot width T
+    defaults to the side's mean row degree (power of two in [8, 512]).
+    ``chunk`` counts SLOTS per scan step (each T entries wide), not nnz, and
+    explicit values are clamped into the transient budget.
     """
     from oryx_tpu.common import rand
+
+    if iterations < 1:
+        raise ValueError(f"iterations must be >= 1, got {iterations}")
 
     n_users, n_items = len(batch.users), len(batch.items)
     k = features
     ndev = 1
     if mesh is not None and row_axis is not None:
         ndev = mesh.shape[row_axis]
-    if chunk is None:
-        chunk = _auto_chunk(k)
     auto = _auto_block(k) if block is None else block
     # keep every device busy: no point in blocks wider than a device's share
     block_u = max(32, min(auto, -(-n_users // ndev)))
     block_i = max(32, min(auto, -(-n_items // ndev)))
 
     user_side = make_blocked_side(
-        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk, ndev
+        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
+        slot_width, ndev, features=k,
     )
     item_side = make_blocked_side(
-        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk, ndev
+        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk,
+        slot_width, ndev, features=k,
     )
+    chunk_u, chunk_i = user_side.slot_chunk, item_side.slot_chunk
 
     if key is None:
         key = rand.get_key()
@@ -307,15 +377,15 @@ def als_train(
 
         def put_side(side):
             return tuple(
-                jax.device_put(a, NamedSharding(mesh, P(row_axis, None)))
-                for a in (side.rows, side.cols, side.vals)
+                jax.device_put(a, NamedSharding(mesh, P(row_axis, *([None] * (a.ndim - 1)))))
+                for a in (side.srows, side.scols, side.svals, side.slens)
             )
 
         u_arrays = put_side(user_side)
         i_arrays = put_side(item_side)
         y = jax.device_put(y, row_shard)
-        solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit, chunk)
-        solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit, chunk)
+        solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit, chunk_u)
+        solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit, chunk_i)
         x = None
         for _ in range(iterations):
             x = solve_u(y, *u_arrays, lam, alpha)
@@ -325,11 +395,13 @@ def als_train(
     x = None
     for _ in range(iterations):
         x = solve_side_blocked(
-            y, user_side.rows, user_side.cols, user_side.vals, lam, alpha,
-            block=block_u, features=k, implicit=implicit, chunk=chunk,
+            y, user_side.srows, user_side.scols, user_side.svals,
+            user_side.slens, lam, alpha,
+            block=block_u, features=k, implicit=implicit, slot_chunk=chunk_u,
         )
         y = solve_side_blocked(
-            x, item_side.rows, item_side.cols, item_side.vals, lam, alpha,
-            block=block_i, features=k, implicit=implicit, chunk=chunk,
+            x, item_side.srows, item_side.scols, item_side.svals,
+            item_side.slens, lam, alpha,
+            block=block_i, features=k, implicit=implicit, slot_chunk=chunk_i,
         )
     return x[:n_users], y[:n_items]
