@@ -67,3 +67,17 @@ def get_used_memory() -> int:
 
     # fallback (non-Linux): peak RSS; ru_maxrss is KiB on Linux
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def pin_cpu_platform_if_forced() -> None:
+    """Honor ``JAX_PLATFORMS=cpu`` even where a site hook wraps jax's backend
+    lookup (the axon TPU plugin initializes every registered backend on
+    discovery, so a hung accelerator tunnel blocks forever): the config
+    update — not the env var — is what actually keeps device discovery on
+    the host platform. Call before the first jax operation."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -106,6 +106,12 @@ oryx = {
     # one chip's memory; top-N becomes per-shard top-k + cross-shard merge.
     compute = {
       sharded = false
+      # Gather concurrent top-N requests for up to coalesce-window-ms (or
+      # coalesce-max-batch) and answer them with ONE batched device call —
+      # the TPU-shaped replacement for the reference's per-request
+      # thread-fanned partition scans. 0 disables.
+      coalesce-window-ms = 1.0
+      coalesce-max-batch = 256
     }
   }
 

@@ -168,7 +168,15 @@ def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
 class _YSnapshot:
     """Immutable device view of Y: ids, matrix, norms, LSH buckets. With a
     mesh, the scoring copy is row-sharded over ``shard_axis`` (rows padded to
-    the shard count) so Y may exceed a single device's memory."""
+    the shard count) so Y may exceed a single device's memory.
+
+    ``prev`` + ``delta`` ((changed base-row indices, appended-row count) from
+    FeatureVectorStore.delta_since) build the snapshot INCREMENTALLY after a
+    speed microbatch of point updates: norms and the bf16 scoring copy are
+    whole-matrix device ops (no transfer), and LSH buckets recompute for only
+    the changed/appended rows — the reference's in-place update semantics
+    (ALSServingModel.java:320-370) without ever re-uploading or re-hashing
+    the full matrix."""
 
     def __init__(
         self,
@@ -177,10 +185,21 @@ class _YSnapshot:
         lsh: LocalitySensitiveHash | None,
         mesh=None,
         shard_axis: str = "model",
+        prev: "_YSnapshot | None" = None,
+        delta: "tuple[np.ndarray, int] | None" = None,
     ):
         self.ids = ids
         self.mat = mat  # jax (n, k) or None, float32
-        self.id_to_idx = {s: i for i, s in enumerate(ids)}
+        if prev is not None and delta is not None:
+            # id→idx is append-only across incremental generations; sharing
+            # the dict avoids an O(n) rebuild per microbatch (extra entries
+            # in the older snapshot only affect exclusion masks, which drop
+            # out-of-range rows on device)
+            self.id_to_idx = prev.id_to_idx
+            for i in range(len(prev.ids), len(ids)):
+                self.id_to_idx[ids[i]] = i
+        else:
+            self.id_to_idx = {s: i for i, s in enumerate(ids)}
         self.mesh = mesh
         self.shard_axis = shard_axis
         self.sharded_mat = None
@@ -192,10 +211,28 @@ class _YSnapshot:
             self.score_mat = (
                 mat.astype(jnp.bfloat16) if jax.default_backend() == "tpu" else mat
             )
-            host = np.asarray(mat)
-            self.buckets = (
-                jnp.asarray(lsh.assign_buckets(host)) if lsh and lsh.num_hashes else None
-            )
+            if lsh and lsh.num_hashes:
+                if prev is not None and delta is not None and prev.buckets is not None:
+                    # rehash only the delta: pull changed/new rows (not the
+                    # whole matrix) to host for bucket assignment
+                    buckets = prev.buckets
+                    ch, n_new = delta
+                    if len(ch):
+                        ch_j = jnp.asarray(ch, dtype=jnp.int32)
+                        new_b = jnp.asarray(
+                            lsh.assign_buckets(np.asarray(mat[ch_j]))
+                        )
+                        buckets = buckets.at[ch_j].set(new_b)
+                    if n_new:
+                        tail = np.asarray(mat[len(prev.ids):])
+                        buckets = jnp.concatenate(
+                            [buckets, jnp.asarray(lsh.assign_buckets(tail))]
+                        )
+                    self.buckets = buckets
+                else:
+                    self.buckets = jnp.asarray(lsh.assign_buckets(np.asarray(mat)))
+            else:
+                self.buckets = None
             if mesh is not None:
                 n_shards = mesh.shape[shard_axis]
                 pad = (-mat.shape[0]) % n_shards
@@ -350,8 +387,17 @@ class ALSServingModel(ServingModel):
         ids, mat = self.y.materialize()
         with self._snap_lock:
             if self._snapshot is None or self._snapshot_src is not mat:
+                prev, delta = None, None
+                if self._snapshot is not None and self._snapshot.mat is not None \
+                        and mat is not None:
+                    # catch up across any number of incremental generations
+                    # (e.g. get_vtv consumed pending batches in between)
+                    delta = self.y.delta_since(self._snapshot.mat, mat)
+                    if delta is not None:
+                        prev = self._snapshot
                 self._snapshot = _YSnapshot(
-                    ids, mat, self.lsh, self.mesh, self.shard_axis
+                    ids, mat, self.lsh, self.mesh, self.shard_axis,
+                    prev=prev, delta=delta,
                 )
                 self._snapshot_src = mat
             return self._snapshot

@@ -157,6 +157,9 @@ def make_blocked_side(
     sblock = srow_f // block
     bounds = np.searchsorted(sblock, np.arange(n_blocks + 1, dtype=np.int64))
     max_s = int(np.diff(bounds).max()) if total_slots else 0
+    # cap the chunk at ~1/8 of the fullest block so rounding S up to a chunk
+    # multiple wastes at most ~12% (a chunk comparable to S can double it)
+    slot_chunk = max(16, min(slot_chunk, max(64, -(-max(max_s, 1) // 8))))
     s_len = max(slot_chunk, -(-max(max_s, 1) // slot_chunk) * slot_chunk)
 
     # Slot packing bounds skew damage (a hot row just spans more slots), but
@@ -305,6 +308,46 @@ def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk):
     return jax.jit(sm)
 
 
+def prepare_blocked(
+    batch: RatingBatch,
+    features: int,
+    ndev: int = 1,
+    block: int | None = None,
+    chunk: int | None = None,
+    slot_width: int | None = None,
+) -> tuple[_BlockedSide, _BlockedSide]:
+    """Pack both half-iteration sides with production block/chunk sizing.
+
+    The single setup path shared by :func:`als_train` and the training
+    benchmark, so published throughput always measures the same layout
+    production uses."""
+    n_users, n_items = len(batch.users), len(batch.items)
+    auto = _auto_block(features) if block is None else block
+    # keep every device busy: no point in blocks wider than a device's share
+    block_u = max(32, min(auto, -(-n_users // ndev)))
+    block_i = max(32, min(auto, -(-n_items // ndev)))
+    user_side = make_blocked_side(
+        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
+        slot_width, ndev, features=features,
+    )
+    item_side = make_blocked_side(
+        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk,
+        slot_width, ndev, features=features,
+    )
+    return user_side, item_side
+
+
+def init_item_factors(item_side: _BlockedSide, n_items: int, features: int,
+                      key) -> jnp.ndarray:
+    """Random Y₀ in the padded factor buffer (gathers only ever index real
+    rows < n_items, so padding rows are never read)."""
+    k1, _ = jax.random.split(key)
+    y0 = 0.1 * jax.random.normal(k1, (n_items, features), dtype=jnp.float32)
+    return jnp.zeros(
+        (item_side.padded_rows, features), dtype=jnp.float32
+    ).at[:n_items].set(y0)
+
+
 def als_train(
     batch: RatingBatch,
     features: int,
@@ -347,28 +390,15 @@ def als_train(
     ndev = 1
     if mesh is not None and row_axis is not None:
         ndev = mesh.shape[row_axis]
-    auto = _auto_block(k) if block is None else block
-    # keep every device busy: no point in blocks wider than a device's share
-    block_u = max(32, min(auto, -(-n_users // ndev)))
-    block_i = max(32, min(auto, -(-n_items // ndev)))
-
-    user_side = make_blocked_side(
-        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
-        slot_width, ndev, features=k,
+    user_side, item_side = prepare_blocked(
+        batch, k, ndev, block=block, chunk=chunk, slot_width=slot_width
     )
-    item_side = make_blocked_side(
-        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk,
-        slot_width, ndev, features=k,
-    )
+    block_u, block_i = user_side.block, item_side.block
     chunk_u, chunk_i = user_side.slot_chunk, item_side.slot_chunk
 
     if key is None:
         key = rand.get_key()
-    k1, _ = jax.random.split(key)
-    y0 = 0.1 * jax.random.normal(k1, (n_items, k), dtype=jnp.float32)
-    # padded factor buffers: gathers only ever index real rows (< n_cols),
-    # so padding rows are never read
-    y = jnp.zeros((item_side.padded_rows, k), dtype=jnp.float32).at[:n_items].set(y0)
+    y = init_item_factors(item_side, n_items, k, key)
 
     if mesh is not None and row_axis is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -8,19 +8,45 @@ GC on model handoff.
 
 TPU re-design: where the reference partitions vectors across threads so
 serving scans parallelize on cores, here the whole store materializes into one
-dense device matrix (id order pinned) behind a dirty flag — scans become a
-single MXU matmul (models/als/serving.py), and per-id point updates only touch
-host state until the next materialization. get_vtv (the Gramian for fold-in
-solves) is one X.T @ X on device.
+dense device matrix (id order pinned) behind a version counter — scans become
+a single MXU matmul (models/als/serving.py). Point updates (speed-layer UP
+messages, ALSServingModel.java:320-370's in-place setters) accumulate in a
+pending map and fold into the EXISTING device matrix as one batched scatter
+(``mat.at[idx].set``) plus one append for new ids — device-side double
+buffering: the old matrix stays intact for in-flight queries, and the full
+host→device re-upload happens only on whole-model handoffs (bulk_load /
+retain GC / removals). get_vtv (the Gramian for fold-in solves) is one
+X.T @ X on device.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
+import weakref
 
 import numpy as np
 
 from oryx_tpu.common.lockutils import AutoReadWriteLock
+
+
+class Transition:
+    """One incremental materialization step: ``new_mat`` is ``prev_mat`` with
+    rows ``changed_idx`` rewritten and ``n_new`` rows appended. Consumers
+    holding a snapshot of ``prev_mat`` (ALSServingModel._YSnapshot) use this
+    to update derived per-row state (LSH buckets) for only the delta.
+
+    Matrices are held by WEAK reference: the log must never pin old device
+    buffers in HBM — once every consumer drops a generation, the chain
+    through it simply breaks and the consumer falls back to a full rebuild."""
+
+    __slots__ = ("prev_ref", "new_ref", "changed_idx", "n_new")
+
+    def __init__(self, prev_mat, new_mat, changed_idx: np.ndarray, n_new: int):
+        self.prev_ref = weakref.ref(prev_mat)
+        self.new_ref = weakref.ref(new_mat)
+        self.changed_idx = changed_idx
+        self.n_new = n_new
 
 
 class FeatureVectorStore:
@@ -33,8 +59,19 @@ class FeatureVectorStore:
         self._version = 0
         self._cache_lock = threading.Lock()
         self._cached_ids: list[str] | None = None
+        self._cached_index: dict[str, int] = {}
         self._cached_matrix = None  # jax array
         self._cached_version = -1
+        # point updates since the last materialization; applied as one
+        # batched device scatter unless a structural change forces a rebuild
+        self._pending_updates: dict[str, np.ndarray] = {}
+        self._needs_rebuild = False
+        # recent incremental steps (weak matrix refs): lets a snapshot
+        # consumer catch up across SEVERAL materialize generations — e.g.
+        # when get_vtv consumed a pending batch between its y_snapshot calls
+        self._transitions: collections.deque[Transition] = collections.deque(
+            maxlen=8
+        )
 
     # -- map ops (FeatureVectorsPartition:55-108) ---------------------------
     def set_vector(self, id_: str, vector: np.ndarray) -> None:
@@ -42,6 +79,7 @@ class FeatureVectorStore:
         with self._lock.write():
             self._vectors[id_] = v
             self._recent_ids.add(id_)
+            self._pending_updates[id_] = v
             self._version += 1
 
     def bulk_load(self, ids, matrix: np.ndarray) -> None:
@@ -52,6 +90,8 @@ class FeatureVectorStore:
             for i, id_ in enumerate(ids):
                 self._vectors[id_] = matrix[i]
                 self._recent_ids.add(id_)
+            self._pending_updates.clear()
+            self._needs_rebuild = True
             self._version += 1
 
     def get_vector(self, id_: str) -> "np.ndarray | None":
@@ -60,8 +100,10 @@ class FeatureVectorStore:
 
     def remove_vector(self, id_: str) -> None:
         with self._lock.write():
-            self._vectors.pop(id_, None)
+            if self._vectors.pop(id_, None) is not None:
+                self._needs_rebuild = True  # row deletion compacts the matrix
             self._recent_ids.discard(id_)
+            self._pending_updates.pop(id_, None)
             self._version += 1
 
     def size(self) -> int:
@@ -81,34 +123,120 @@ class FeatureVectorStore:
                 if k not in keep:
                     del self._vectors[k]
             self._recent_ids.clear()
+            self._pending_updates.clear()
+            self._needs_rebuild = True
             self._version += 1
 
     # -- device materialization --------------------------------------------
     def materialize(self):
-        """(ids, device matrix) snapshot; rebuilt only when writes happened
-        since the cached version (race-free: the version is read under the
-        same read lock as the snapshot, so a concurrent write strictly
-        invalidates this materialization)."""
+        """(ids, device matrix) snapshot; incremental when only point updates
+        happened since the cache (one batched scatter + one append — never a
+        full host→device upload), full rebuild on structural changes.
+
+        Race-free: the version and pending set are read under the read lock
+        (writers excluded), and the cache critical section is serialized, so
+        a concurrent write strictly invalidates this materialization. The
+        full-rebuild device upload happens OUTSIDE the locks (it can take
+        seconds at reference scale and must not stall UP-consumer writes);
+        the incremental path only dispatches async device ops and commits
+        inline."""
         import jax.numpy as jnp
 
-        with self._lock.read():
+        with self._lock.read(), self._cache_lock:
             version = self._version
-            with self._cache_lock:
-                if self._cached_version == version:
-                    return self._cached_ids, self._cached_matrix
+            if self._cached_version == version:
+                return self._cached_ids, self._cached_matrix
+            pending, self._pending_updates = self._pending_updates, {}
+            k = (
+                self._cached_matrix.shape[1]
+                if self._cached_matrix is not None
+                else None
+            )
+            if (
+                self._cached_matrix is not None
+                and not self._needs_rebuild
+                and pending
+                and all(v.shape == (k,) for v in pending.values())
+            ):
+                changed_idx, changed_vals, new_ids, new_vecs = [], [], [], []
+                for id_, vec in pending.items():
+                    j = self._cached_index.get(id_)
+                    if j is None:
+                        new_ids.append(id_)
+                        new_vecs.append(vec)
+                    else:
+                        changed_idx.append(j)
+                        changed_vals.append(vec)
+                prev_mat = self._cached_matrix
+                mat = prev_mat
+                if changed_idx:
+                    mat = mat.at[jnp.asarray(changed_idx, dtype=jnp.int32)].set(
+                        jnp.asarray(np.stack(changed_vals))
+                    )
+                if new_vecs:
+                    mat = jnp.concatenate([mat, jnp.asarray(np.stack(new_vecs))])
+                # new list: snapshots holding the previous ids list stay valid
+                ids = self._cached_ids + new_ids
+                for i, id_ in enumerate(new_ids):
+                    self._cached_index[id_] = len(self._cached_ids) + i
+                self._transitions.append(Transition(
+                    prev_mat, mat,
+                    np.asarray(changed_idx, dtype=np.int64), len(new_ids),
+                ))
+                self._cached_ids = ids
+                self._cached_matrix = mat
+                self._cached_version = version
+                return ids, mat
+
+            # full rebuild (first build, bulk handoff, removals, width
+            # change): capture the host copy under the locks, upload outside
+            self._needs_rebuild = False
             ids = list(self._vectors)
-            mat = (
+            host = (
                 np.stack([self._vectors[i] for i in ids])
                 if ids
                 else np.zeros((0, 0), dtype=np.float32)
             )
-        device_mat = jnp.asarray(mat) if mat.size else None
+        mat = jnp.asarray(host) if host.size else None
         with self._cache_lock:
             if version > self._cached_version:
                 self._cached_ids = ids
-                self._cached_matrix = device_mat
+                self._cached_index = {s: i for i, s in enumerate(ids)}
+                self._cached_matrix = mat
                 self._cached_version = version
+                self._transitions.clear()
             return self._cached_ids, self._cached_matrix
+
+    def delta_since(self, from_mat, to_mat) -> "tuple[np.ndarray, int] | None":
+        """Compose the recorded incremental steps from ``from_mat`` up to
+        ``to_mat``: (changed row indices within from_mat's rows, rows
+        appended). None when the chain is broken (full rebuild happened, a
+        generation was garbage-collected, or either matrix is unknown) — the
+        consumer then rebuilds its derived state from scratch."""
+        with self._cache_lock:
+            chain = list(self._transitions)
+        if from_mat is to_mat:
+            return np.empty(0, dtype=np.int64), 0
+        start = next(
+            (i for i, t in enumerate(chain) if t.prev_ref() is from_mat), None
+        )
+        if start is None:
+            return None
+        # continuity within the log is structural (each step's prev IS the
+        # previous step's output, and a full rebuild clears the log), so
+        # intermediate generations need no liveness check — only the two
+        # endpoints, which the caller holds alive, anchor the walk
+        n_base = from_mat.shape[0]
+        changed: set[int] = set()
+        n_new = 0
+        for t in chain[start:]:
+            # rows rewritten inside the appended tail are covered by the
+            # consumer's whole-tail refresh; only base rows need listing
+            changed.update(int(i) for i in t.changed_idx if i < n_base)
+            n_new += t.n_new
+            if t.new_ref() is to_mat:
+                return np.asarray(sorted(changed), dtype=np.int64), n_new
+        return None
 
     def get_vtv(self):
         """Gramian V^T V on device (FeatureVectors.getVTV)."""

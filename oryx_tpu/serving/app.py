@@ -61,6 +61,15 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     app[rsrc.MANAGER_KEY] = manager
     app[rsrc.INPUT_PRODUCER_KEY] = input_producer
 
+    window_ms = config.get_float("oryx.serving.compute.coalesce-window-ms", 1.0)
+    if window_ms > 0:
+        from oryx_tpu.serving.batcher import TopNCoalescer
+
+        app[rsrc.COALESCER_KEY] = TopNCoalescer(
+            window_ms,
+            config.get_int("oryx.serving.compute.coalesce-max-batch", 256),
+        )
+
     modules = list(DEFAULT_RESOURCES)
     configured = config.get("oryx.serving.application-resources", None)
     if configured:

@@ -24,6 +24,7 @@ from oryx_tpu.api.serving import OryxServingException
 MANAGER_KEY = "oryx.model-manager"
 INPUT_PRODUCER_KEY = "oryx.input-producer"
 CONFIG_KEY = "oryx.config"
+COALESCER_KEY = "oryx.top-n-coalescer"
 
 
 def get_manager(request: web.Request):

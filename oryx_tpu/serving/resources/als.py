@@ -45,6 +45,22 @@ async def _run(request, fn, *args):
     return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
 
 
+async def _top_n(request, model, vec, how_many, offset, allowed, rescore,
+                 excluded):
+    """Recommend-family top-N: coalesced into one batched device call with
+    concurrent requests when no score-rewriting rescorer applies (a shared
+    scan cannot honor per-request rescore hooks)."""
+    coalescer = request.app.get(rsrc.COALESCER_KEY)
+    if coalescer is not None and rescore is None:
+        return await coalescer.top_n(model, vec, how_many, offset, allowed,
+                                     excluded)
+    return await _run(
+        request,
+        lambda: model.top_n(vec, how_many, offset, allowed, rescore,
+                            excluded=excluded),
+    )
+
+
 def _combine_allowed_rescore(allowed, rescorer):
     if rescorer is None:
         return allowed, None
@@ -80,9 +96,8 @@ async def recommend(request: web.Request) -> web.Response:
         else None
     )
     allowed, rescore = _combine_allowed_rescore(None, rescorer)
-    results = await _run(
-        request,
-        lambda: model.top_n(uv, how_many, offset, allowed, rescore, excluded=known),
+    results = await _top_n(
+        request, model, uv, how_many, offset, allowed, rescore, known
     )
     return render(request, [id_value(i, s) for i, s in results])
 
@@ -108,9 +123,8 @@ async def recommend_to_many(request: web.Request) -> web.Response:
         else None
     )
     allowed, rescore = _combine_allowed_rescore(None, rescorer)
-    results = await _run(
-        request,
-        lambda: model.top_n(mean_vec, how_many, offset, allowed, rescore, excluded=known),
+    results = await _top_n(
+        request, model, mean_vec, how_many, offset, allowed, rescore, known
     )
     return render(request, [id_value(i, s) for i, s in results])
 
@@ -133,9 +147,8 @@ async def recommend_to_anonymous(request: web.Request) -> web.Response:
         else None
     )
     allowed, rescore = _combine_allowed_rescore(None, rescorer)
-    results = await _run(
-        request,
-        lambda: model.top_n(vec, how_many, offset, allowed, rescore, excluded=context_items),
+    results = await _top_n(
+        request, model, vec, how_many, offset, allowed, rescore, context_items
     )
     return render(request, [id_value(i, s) for i, s in results])
 
@@ -161,9 +174,8 @@ async def recommend_with_context(request: web.Request) -> web.Response:
         else None
     )
     allowed, rescore = _combine_allowed_rescore(None, rescorer)
-    results = await _run(
-        request,
-        lambda: model.top_n(vec, how_many, offset, allowed, rescore, excluded=known),
+    results = await _top_n(
+        request, model, vec, how_many, offset, allowed, rescore, known
     )
     return render(request, [id_value(i, s) for i, s in results])
 

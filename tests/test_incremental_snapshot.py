@@ -1,0 +1,184 @@
+"""Incremental device-snapshot maintenance (VERDICT r4 #5): a speed
+microbatch of UP point updates must fold into the existing device matrix as
+one batched scatter + append — never a full host→device re-upload — matching
+the reference's in-place update semantics
+(app/oryx-app-serving/.../als/model/ALSServingModel.java:320-370)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.models.als import vectors as vmod
+from oryx_tpu.models.als.serving import ALSServingModel
+from oryx_tpu.models.als.vectors import FeatureVectorStore
+
+
+@pytest.fixture
+def counting_stack(monkeypatch):
+    """Counts rows passing through np.stack inside vectors.py — the full
+    rebuild stacks ALL vectors; the incremental path only the delta."""
+    counts = []
+    orig = np.stack
+
+    def counting(arrays, *a, **kw):
+        arrays = list(arrays)
+        counts.append(len(arrays))
+        return orig(arrays, *a, **kw)
+
+    monkeypatch.setattr(vmod.np, "stack", counting)
+    return counts
+
+
+def _loaded_store(n=500, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    store = FeatureVectorStore()
+    mat = rng.standard_normal((n, k)).astype(np.float32)
+    store.bulk_load([f"i{i}" for i in range(n)], mat)
+    return store, mat
+
+
+def test_point_updates_do_not_reupload(counting_stack):
+    store, _ = _loaded_store(n=500)
+    ids0, mat0 = store.materialize()
+    assert counting_stack == [500]  # initial full build
+
+    counting_stack.clear()
+    upd = {f"i{i}": np.full(8, float(i), dtype=np.float32) for i in (3, 99, 250)}
+    for id_, v in upd.items():
+        store.set_vector(id_, v)
+    ids1, mat1 = store.materialize()
+
+    # only the 3-row delta crossed the host boundary
+    assert counting_stack == [3]
+    assert mat1 is not mat0  # double-buffered: old snapshot stays valid
+    delta = store.delta_since(mat0, mat1)
+    assert delta is not None
+    changed, n_new = delta
+    assert sorted(changed.tolist()) == [3, 99, 250] and n_new == 0
+    for id_, v in upd.items():
+        np.testing.assert_array_equal(np.asarray(mat1)[ids1.index(id_)], v)
+    # untouched rows identical, old matrix unmodified
+    np.testing.assert_array_equal(np.asarray(mat1)[0], np.asarray(mat0)[0])
+    assert not np.array_equal(np.asarray(mat0)[3], upd["i3"])
+
+
+def test_new_ids_append_without_reupload(counting_stack):
+    store, _ = _loaded_store(n=200)
+    ids0, mat0 = store.materialize()
+    counting_stack.clear()
+
+    store.set_vector("fresh1", np.ones(8, dtype=np.float32))
+    store.set_vector("fresh2", 2 * np.ones(8, dtype=np.float32))
+    ids1, mat1 = store.materialize()
+
+    assert counting_stack == [2]
+    assert len(ids1) == 202 and mat1.shape == (202, 8)
+    assert ids1[-2:] == ["fresh1", "fresh2"]
+    assert store.delta_since(mat0, mat1)[1] == 2
+    # the previous snapshot's ids list was not mutated
+    assert len(ids0) == 200
+
+
+def test_incremental_equals_full_rebuild():
+    store, mat = _loaded_store(n=120)
+    store.materialize()
+    rng = np.random.default_rng(7)
+    for i in rng.integers(0, 120, 20):
+        store.set_vector(f"i{i}", rng.standard_normal(8).astype(np.float32))
+    store.set_vector("new", rng.standard_normal(8).astype(np.float32))
+    ids_inc, mat_inc = store.materialize()
+
+    fresh = FeatureVectorStore()
+    for id_ in ids_inc:
+        fresh.set_vector(id_, store.get_vector(id_))
+    ids_full, mat_full = fresh.materialize()
+    assert ids_inc == ids_full
+    np.testing.assert_array_equal(np.asarray(mat_inc), np.asarray(mat_full))
+
+
+def test_removal_forces_rebuild(counting_stack):
+    store, _ = _loaded_store(n=50)
+    _, mat0 = store.materialize()
+    counting_stack.clear()
+    store.remove_vector("i7")
+    ids, mat = store.materialize()
+    assert counting_stack == [49]  # full rebuild compacts the deleted row
+    assert "i7" not in ids and mat.shape[0] == 49
+    assert store.delta_since(mat0, mat) is None  # chain cut by the rebuild
+
+
+def test_delta_chain_survives_interleaved_consumers():
+    """get_vtv (the solver cache) consuming pending batches between snapshot
+    reads must NOT force the snapshot back to a full rebuild: deltas compose
+    across generations."""
+    store, _ = _loaded_store(n=100)
+    _, mat0 = store.materialize()
+    store.set_vector("i5", np.ones(8, dtype=np.float32))
+    store.get_vtv()  # consumes the pending batch (generation 1)
+    store.set_vector("i9", 2 * np.ones(8, dtype=np.float32))
+    store.set_vector("late", 3 * np.ones(8, dtype=np.float32))
+    _, mat2 = store.materialize()  # generation 2
+
+    delta = store.delta_since(mat0, mat2)
+    assert delta is not None, "composed delta lost across generations"
+    changed, n_new = delta
+    assert sorted(changed.tolist()) == [5, 9] and n_new == 1
+
+
+def test_snapshot_reuses_lsh_buckets(monkeypatch):
+    """After a microbatch of UPs, the serving snapshot rehashes only the
+    changed rows (not all of Y) and answers queries correctly."""
+    from oryx_tpu.models.als.lsh import LocalitySensitiveHash
+
+    rng = np.random.default_rng(3)
+    model = ALSServingModel(16, implicit=True, sample_rate=0.5)
+    n = 400
+    y = rng.standard_normal((n, 16)).astype(np.float32)
+    model.bulk_load_items([f"i{i}" for i in range(n)], y)
+    snap0 = model.y_snapshot()
+    assert snap0.buckets is not None
+
+    hashed_rows = []
+    orig = LocalitySensitiveHash.assign_buckets
+
+    def counting(self, mat):
+        hashed_rows.append(len(mat))
+        return orig(self, mat)
+
+    monkeypatch.setattr(LocalitySensitiveHash, "assign_buckets", counting)
+
+    model.set_item_vector("i13", rng.standard_normal(16).astype(np.float32))
+    model.set_item_vector("brand-new", rng.standard_normal(16).astype(np.float32))
+    snap1 = model.y_snapshot()
+
+    assert hashed_rows == [1, 1]  # one changed row + one appended row
+    assert snap1.mat.shape[0] == n + 1
+    # bucket bookkeeping stayed consistent with a from-scratch assignment
+    expect = orig(model.lsh, np.asarray(snap1.mat))
+    np.testing.assert_array_equal(np.asarray(snap1.buckets), expect)
+    # queries still answer on the LSH path
+    res = model.top_n(rng.standard_normal(16).astype(np.float32), 5)
+    assert len(res) == 5
+
+
+def test_sustained_update_query_latency():
+    """Sustained UP + query interleave must stay fast: no per-microbatch
+    full re-materialization of a 50k-row matrix (the old behavior made every
+    cycle O(n) host-side; 60 cycles would take minutes, not seconds)."""
+    rng = np.random.default_rng(5)
+    n, k = 50_000, 16
+    model = ALSServingModel(k, implicit=True)
+    model.bulk_load_items([f"i{i}" for i in range(n)],
+                          rng.standard_normal((n, k)).astype(np.float32))
+    q = rng.standard_normal(k).astype(np.float32)
+    _ = model.top_n(q, 5)  # build + compile
+
+    t0 = time.perf_counter()
+    for c in range(60):
+        model.set_item_vector(f"i{c * 101 % n}",
+                              rng.standard_normal(k).astype(np.float32))
+        res = model.top_n(q, 5)
+        assert len(res) == 5
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 20.0, f"60 update+query cycles took {elapsed:.1f}s"
