@@ -255,6 +255,9 @@ if __name__ == "__main__":
         try:
             print(json.dumps(_serving_bench()))
         except Exception as e:  # noqa: BLE001 — always emit a JSON line
-            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            print(json.dumps({
+                "metric": "als_recommend_throughput_1M_items_50f",
+                "error": f"{type(e).__name__}: {e}",
+            }))
         sys.exit(0)
     sys.exit(main())

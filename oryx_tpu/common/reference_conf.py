@@ -140,6 +140,18 @@ oryx = {
       hyperparam-search = "random"
       parallelism = 1
       threshold = null
+      # Speculative backup execution for straggling candidate builds — the
+      # equivalent of the reference's spark.speculation (reference.conf:86):
+      # a candidate running longer than multiplier x the median completed
+      # build (at least min-runtime-sec) gets one backup attempt on another
+      # device; first finisher wins. timeout-sec abandons a candidate whose
+      # attempts all hang (null = wait forever).
+      speculation = {
+        enabled = true
+        multiplier = 1.5
+        min-runtime-sec = 10
+        timeout-sec = null
+      }
     }
   }
 

@@ -48,6 +48,18 @@ class MLUpdate(BatchLayerUpdate):
             log.info("test-fraction = 0 so candidates is overridden to 1")
             candidates = 1
         self.candidates = candidates
+        # speculative backup execution for straggling candidate builds
+        # (reference spark.speculation, reference.conf:86)
+        self.speculation = config.get_bool("oryx.ml.eval.speculation.enabled", True)
+        self.speculation_multiplier = config.get_float(
+            "oryx.ml.eval.speculation.multiplier", 1.5
+        )
+        self.speculation_min_runtime = config.get_float(
+            "oryx.ml.eval.speculation.min-runtime-sec", 10.0
+        )
+        self.speculation_timeout = config.get(
+            "oryx.ml.eval.speculation.timeout-sec", None
+        )
 
     # -- abstract surface (MLUpdate.java:113-157) ---------------------------
     def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
@@ -142,14 +154,16 @@ class MLUpdate(BatchLayerUpdate):
             if len(local) > 1:
                 devices = local
 
-        def build_and_eval(i: int):
-            candidate_path = scratch / f"{i}"
+        def build_and_eval(i: int, attempt: int = 0):
+            # a backup attempt writes to its own path and prefers a DIFFERENT
+            # device than the original, mirroring Spark's speculative copies
+            candidate_path = scratch / (f"{i}" if attempt == 0 else f"{i}.{attempt}")
             candidate_path.mkdir(parents=True, exist_ok=True)
             try:
                 if devices is not None:
                     import jax
 
-                    with jax.default_device(devices[i % len(devices)]):
+                    with jax.default_device(devices[(i + attempt) % len(devices)]):
                         pmml = self.build_model(context, train, combos[i], candidate_path)
                 else:
                     pmml = self.build_model(context, train, combos[i], candidate_path)
@@ -166,9 +180,21 @@ class MLUpdate(BatchLayerUpdate):
             log.info("candidate %d (%s) eval = %s", i, combos[i], eval_result)
             return candidate_path, eval_result
 
-        results = executils.collect_in_parallel(
-            len(combos), build_and_eval, self.eval_parallelism
-        )
+        if self.speculation:
+            results = executils.collect_speculative(
+                len(combos), build_and_eval, self.eval_parallelism,
+                multiplier=self.speculation_multiplier,
+                min_runtime_sec=self.speculation_min_runtime,
+                abandon_sec=(
+                    float(self.speculation_timeout)
+                    if self.speculation_timeout is not None
+                    else None
+                ),
+            )
+        else:
+            results = executils.collect_in_parallel(
+                len(combos), build_and_eval, self.eval_parallelism
+            )
         best = None
         for r in results:
             if r is None:

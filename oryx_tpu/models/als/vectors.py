@@ -65,7 +65,11 @@ class FeatureVectorStore:
         # point updates since the last materialization; applied as one
         # batched device scatter unless a structural change forces a rebuild
         self._pending_updates: dict[str, np.ndarray] = {}
-        self._needs_rebuild = False
+        # version at which the last STRUCTURAL change (bulk handoff, removal,
+        # GC) happened: incremental materialization is sound only from a
+        # cache at/after this point. Never cleared — comparing versions is
+        # race-free where clearing a boolean after a lock release is not.
+        self._rebuild_needed_at = 0
         # recent incremental steps (weak matrix refs): lets a snapshot
         # consumer catch up across SEVERAL materialize generations — e.g.
         # when get_vtv consumed a pending batch between its y_snapshot calls
@@ -91,8 +95,8 @@ class FeatureVectorStore:
                 self._vectors[id_] = matrix[i]
                 self._recent_ids.add(id_)
             self._pending_updates.clear()
-            self._needs_rebuild = True
             self._version += 1
+            self._rebuild_needed_at = self._version
 
     def get_vector(self, id_: str) -> "np.ndarray | None":
         with self._lock.read():
@@ -100,11 +104,12 @@ class FeatureVectorStore:
 
     def remove_vector(self, id_: str) -> None:
         with self._lock.write():
-            if self._vectors.pop(id_, None) is not None:
-                self._needs_rebuild = True  # row deletion compacts the matrix
+            removed = self._vectors.pop(id_, None) is not None
             self._recent_ids.discard(id_)
             self._pending_updates.pop(id_, None)
             self._version += 1
+            if removed:  # row deletion compacts the matrix
+                self._rebuild_needed_at = self._version
 
     def size(self) -> int:
         with self._lock.read():
@@ -124,8 +129,8 @@ class FeatureVectorStore:
                     del self._vectors[k]
             self._recent_ids.clear()
             self._pending_updates.clear()
-            self._needs_rebuild = True
             self._version += 1
+            self._rebuild_needed_at = self._version
 
     # -- device materialization --------------------------------------------
     def materialize(self):
@@ -154,7 +159,7 @@ class FeatureVectorStore:
             )
             if (
                 self._cached_matrix is not None
-                and not self._needs_rebuild
+                and self._rebuild_needed_at <= self._cached_version
                 and pending
                 and all(v.shape == (k,) for v in pending.values())
             ):
@@ -190,7 +195,6 @@ class FeatureVectorStore:
 
             # full rebuild (first build, bulk handoff, removals, width
             # change): capture the host copy under the locks, upload outside
-            self._needs_rebuild = False
             ids = list(self._vectors)
             host = (
                 np.stack([self._vectors[i] for i in ids])

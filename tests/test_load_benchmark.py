@@ -12,12 +12,13 @@ import time
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.skipif(
+_gated = pytest.mark.skipif(
     os.environ.get("ORYX_BENCHMARK") != "1",
     reason="load benchmark is gated; set ORYX_BENCHMARK=1",
 )
 
 
+@_gated
 def test_als_recommend_load():
     from oryx_tpu.models.als.serving import ALSServingModel
 
@@ -58,3 +59,31 @@ def test_als_recommend_load():
         f"rss {get_used_memory() // (1 << 20)} MiB"
     )
     assert qps > 0
+
+
+def test_als_recommend_load_smoke():
+    """Always-on small-shape load smoke (VERDICT r4 #6): the batched top-N
+    serving path must sustain a sane request rate even on the CPU test
+    backend — catches gross throughput regressions in the default suite."""
+    from oryx_tpu.models.als.serving import ALSServingModel
+
+    rng = np.random.default_rng(0)
+    items, features, how_many, batch = 5_000, 16, 5, 128
+    model = ALSServingModel(features, implicit=True)
+    model.bulk_load_items(
+        [f"i{i}" for i in range(items)],
+        rng.standard_normal((items, features)).astype(np.float32),
+    )
+    queries = rng.standard_normal((1024, features)).astype(np.float32)
+    _ = model.top_n_batch(queries[:batch], how_many)  # warm-up/compile
+
+    n_done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 1.0:
+        results = model.top_n_batch(queries[n_done % 896:][:batch], how_many)
+        assert len(results) == batch and len(results[0]) == how_many
+        n_done += batch
+    qps = n_done / (time.perf_counter() - t0)
+    # loose floor: CPU fallback easily exceeds this; a broken scan path
+    # (per-query recompiles, host fallback) does not
+    assert qps > 200, f"serving smoke throughput collapsed: {qps:.0f} qps"

@@ -1,0 +1,153 @@
+"""Speculative/backup execution (VERDICT r4 #7): a hung candidate build must
+not stall the generation — equivalent of the reference's spark.speculation
+(framework/oryx-common/src/main/resources/reference.conf:86)."""
+
+import threading
+import time
+
+import numpy as np
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import executils
+
+
+def test_straggler_gets_backup_and_backup_wins():
+    release = threading.Event()
+    calls = []
+
+    def fn(i, attempt):
+        calls.append((i, attempt))
+        if i == 2 and attempt == 0:
+            release.wait(30)  # simulates a stuck device call
+            return "stuck-finally-done"
+        time.sleep(0.05)
+        return f"ok-{i}-{attempt}"
+
+    t0 = time.monotonic()
+    results = executils.collect_speculative(
+        4, fn, parallelism=4, multiplier=1.5, min_runtime_sec=0.3,
+        poll_sec=0.02,
+    )
+    elapsed = time.monotonic() - t0
+    release.set()
+    assert results == ["ok-0-0", "ok-1-0", "ok-2-1", "ok-3-0"]
+    assert (2, 1) in calls  # backup attempt launched for the straggler
+    assert elapsed < 5.0  # nowhere near the 30 s hang
+
+
+def test_abandon_when_all_attempts_hang():
+    stop = threading.Event()
+
+    def fn(i, attempt):
+        if i == 1:
+            stop.wait(30)  # both attempts hang
+            return None
+        return i
+
+    t0 = time.monotonic()
+    results = executils.collect_speculative(
+        3, fn, parallelism=3, min_runtime_sec=0.2, abandon_sec=1.0,
+        poll_sec=0.02,
+    )
+    elapsed = time.monotonic() - t0
+    stop.set()
+    assert results[0] == 0 and results[2] == 2
+    assert results[1] is None  # abandoned, not waited on
+    assert elapsed < 6.0
+
+
+def test_no_speculation_below_min_runtime():
+    calls = []
+
+    def fn(i, attempt):
+        calls.append((i, attempt))
+        time.sleep(0.05)
+        return i
+
+    results = executils.collect_speculative(
+        4, fn, parallelism=2, min_runtime_sec=10.0, poll_sec=0.02
+    )
+    assert results == [0, 1, 2, 3]
+    assert all(a == 0 for _, a in calls)  # no unnecessary backups
+
+
+def test_failed_task_yields_none_others_survive():
+    def fn(i, attempt):
+        if i == 0:
+            raise RuntimeError("boom")
+        return i
+
+    results = executils.collect_speculative(3, fn, parallelism=3, poll_sec=0.02)
+    assert results == [None, 1, 2]
+
+
+def test_mlupdate_promotes_despite_hanging_candidate(tmp_path):
+    """End-to-end: one of three ALS hyperparameter candidates hangs on its
+    first build attempt (stuck device call); speculation launches a backup
+    and the generation still promotes a model."""
+    from oryx_tpu.api.keymessage import KeyMessage
+    from oryx_tpu.models.als.update import ALSUpdate
+
+    hang_once = threading.Event()
+    lock = threading.Lock()
+    state = {"hung": 0, "released": threading.Event()}
+
+    class HangingALSUpdate(ALSUpdate):
+        def build_model(self, context, train_data, hyper_parameters,
+                        candidate_path):
+            with lock:
+                first = not hang_once.is_set()
+                if first and hyper_parameters[0] == 10:
+                    hang_once.set()
+                    hang = True
+                else:
+                    hang = False
+            if hang:
+                state["hung"] += 1
+                state["released"].wait(30)
+                return None  # resolve instantly once released: the backup
+                # attempt owns this candidate; doing real work here would
+                # race interpreter shutdown
+            return super().build_model(
+                context, train_data, hyper_parameters, candidate_path
+            )
+
+    config = cfg.overlay_on(
+        {
+            "oryx.als.iterations": 2,
+            "oryx.als.hyperparams.features": [5, 10],
+            "oryx.als.hyperparams.lambda": 0.01,
+            "oryx.ml.eval.candidates": 2,
+            "oryx.ml.eval.parallelism": 2,
+            "oryx.ml.eval.hyperparam-search": "grid",
+            "oryx.ml.eval.test-fraction": 0.2,
+            "oryx.ml.eval.speculation.min-runtime-sec": 1.0,
+            "oryx.ml.eval.speculation.multiplier": 1.2,
+            "oryx.ml.eval.speculation.timeout-sec": 25,
+        },
+        cfg.get_default(),
+    )
+    update = HangingALSUpdate(config)
+    rng = np.random.default_rng(0)
+    lines = [
+        f"u{rng.integers(0, 40)},i{rng.integers(0, 30)},1,{n}"
+        for n in range(800)
+    ]
+
+    published = []
+
+    class _Producer:
+        def send(self, key, message):
+            published.append((key, message))
+
+    t0 = time.monotonic()
+    update.run_update(
+        None, 12345, [KeyMessage(None, ln) for ln in lines], [],
+        str(tmp_path / "models"), _Producer(),
+    )
+    elapsed = time.monotonic() - t0
+    state["released"].set()
+    assert state["hung"] == 1  # the injected hang really happened
+    keys = [k for k, _ in published]
+    assert "MODEL" in keys or "MODEL-REF" in keys, "no model promoted"
+    assert elapsed < 25.0, f"generation stalled {elapsed:.1f}s behind the hang"
