@@ -156,6 +156,14 @@ def _serving_bench() -> dict:
         lats.append((time.perf_counter() - t1) * 1000.0)
     lats.sort()
 
+    # HTTP path: the reference's 437 qps was measured at the endpoint
+    # (LoadBenchmark.java:37-110). Serve the same model through the real
+    # aiohttp layer + request coalescer and drive it with concurrent clients.
+    try:
+        http_section = _http_bench(model, queries)
+    except Exception as e:  # noqa: BLE001 — optional section
+        http_section = {"error": f"{type(e).__name__}: {e}"}
+
     # LSH sample-rate 0.3 run — the reference's own best configuration,
     # exercising the per-query LUT masking path
     lsh_model = ALSServingModel(FEATURES, implicit=True, sample_rate=0.3)
@@ -190,7 +198,146 @@ def _serving_bench() -> dict:
             "unit": "recs/s",
             "vs_baseline": round(lsh_qps / BASELINE_QPS, 2),
         },
+        "http": http_section,
     }
+
+
+def _http_bench(model, queries, duration_s: float = 5.0,
+                concurrency: int = 96) -> dict:
+    """Drive the REAL HTTP serving app (aiohttp + request coalescer) against
+    the loaded model with ``concurrency`` in-flight GET /recommend requests —
+    the reference's endpoint-level LoadBenchmark scenario. The coalescer
+    gathers concurrent requests into single batched device calls, so the
+    qps here is the end-to-end HTTP capacity, tunnel RTT included."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.common import ioutils
+    from oryx_tpu.serving.app import make_app
+
+    n_users = min(4096, len(queries))
+    user_ids = [f"u{i}" for i in range(n_users)]
+    model.bulk_load_users(user_ids, queries[:n_users])
+
+    config = cfg.overlay_on(
+        {"oryx.serving.application-resources": "oryx_tpu.serving.resources.als"},
+        cfg.get_default(),
+    )
+
+    class _Manager:
+        rescorer_provider = None
+
+        def get_model(self):
+            return model
+
+        def is_read_only(self):
+            return True
+
+    app = make_app(config, _Manager())
+    port = ioutils.choose_free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app, access_log=None)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    if not started.wait(15):
+        raise RuntimeError("bench HTTP server failed to start")
+
+    try:
+        # warm + compile through the endpoint before timing
+        import httpx
+
+        httpx.get(f"http://127.0.0.1:{port}/recommend/{user_ids[0]}",
+                  timeout=120).raise_for_status()
+        # clients run in SEPARATE processes: in-process clients would steal
+        # the server's GIL and the measurement would cap on client CPU
+        import concurrent.futures as cf
+        import multiprocessing as mp
+
+        n_procs = 3
+        with cf.ProcessPoolExecutor(
+            n_procs, mp_context=mp.get_context("spawn")
+        ) as pool:
+            parts = list(pool.map(
+                _http_client_proc,
+                [(port, n_users, duration_s, concurrency // n_procs)] * n_procs,
+            ))
+        # each client measures its own steady window, so process spawn and
+        # interpreter startup never dilute the rate
+        lat = sorted(x for p, _ in parts for x in p)
+        qps = sum(len(p) / el for p, el in parts)
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+    return {
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 2),
+        "concurrency": concurrency,
+        "p50_ms": round(1000 * lat[len(lat) // 2], 1),
+        "p99_ms": round(1000 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1),
+        "note": "GET /recommend through aiohttp + coalescer, device RTT included",
+    }
+
+
+def _http_client_proc(args) -> tuple:
+    """One client process: ``concurrency`` async in-flight GET /recommend
+    loops for ``duration_s``; returns (per-request latencies, own window).
+    Top-level so the spawn context can pickle it; never imports jax. Uses
+    the aiohttp client — httpx's async path costs several ms per request
+    under concurrency and caps the measurement well below the server."""
+    port, n_users, duration_s, concurrency = args
+    import asyncio
+
+    import aiohttp
+
+    base = f"http://127.0.0.1:{port}"
+
+    async def drive():
+        lat: list[float] = []
+        async with aiohttp.ClientSession() as sess:
+
+            async def get(u: str):
+                async with sess.get(
+                    f"{base}/recommend/{u}?howMany={HOW_MANY}"
+                ) as resp:
+                    assert resp.status == 200, resp.status
+                    await resp.read()
+
+            # ramp: one request per worker before the timed window opens
+            await asyncio.gather(*[
+                get(f"u{i % n_users}") for i in range(concurrency)
+            ])
+            t0 = time.perf_counter()
+            stop_at = t0 + duration_s
+            counter = {"i": 0}
+
+            async def worker():
+                while time.perf_counter() < stop_at:
+                    counter["i"] += 1
+                    u = f"u{counter['i'] % n_users}"
+                    t1 = time.perf_counter()
+                    await get(u)
+                    lat.append(time.perf_counter() - t1)
+
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+            elapsed = time.perf_counter() - t0
+        return lat, elapsed
+
+    return asyncio.run(drive())
 
 
 def _section_subproc(argv: list, timeout: int, force_cpu: bool,

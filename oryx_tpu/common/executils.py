@@ -184,13 +184,20 @@ def collect_speculative(
                 len(attempts) == 1
                 and threshold is not None
                 and runtime > threshold
+                and active() < parallelism  # Spark: speculate into free slots
             ):
                 log.warning(
                     "task %d straggling (%.1fs > %.1fs); launching backup",
                     task, runtime, threshold,
                 )
                 attempts.append(_Attempt(task, 1))
-            if abandon_sec is not None and runtime > abandon_sec:
+            # abandon only when the NEWEST attempt has itself exceeded the
+            # limit — a freshly-launched healthy backup must get its own
+            # full budget, not inherit the hung original's clock
+            if (
+                abandon_sec is not None
+                and now - attempts[-1].start > abandon_sec
+            ):
                 log.error(
                     "task %d abandoned after %.1fs (%d attempts hung)",
                     task, runtime, len(attempts),
