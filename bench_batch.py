@@ -40,13 +40,19 @@ import numpy as np
 FEATURES = 50
 TIME_BUDGET_S = 210.0  # timed-loop budget; compile/warmup budgeted separately
 
-# f32 matmul peak by device kind (TPU runs f32 through the MXU at reduced
-# rate vs bf16; these are the published per-chip peaks)
-_PEAK_F32 = {
-    "TPU v5 lite": 4.925e13,  # v5e: 197 TFLOP/s bf16, f32 ≈ 1/4
-    "TPU v5e": 4.925e13,
-    "cpu": None,  # MFU not meaningful for the host fallback
+# matmul peak by device kind and input dtype (TPU runs f32 through the MXU
+# at reduced rate vs bf16; these are the published per-chip peaks)
+_PEAKS = {
+    "TPU v5 lite": {"float32": 4.925e13, "bfloat16": 1.97e14},  # v5e
+    "TPU v5e": {"float32": 4.925e13, "bfloat16": 1.97e14},
 }
+
+
+def _peak_for(device_kind: str, dtype: str) -> "float | None":
+    for pfx, peaks in _PEAKS.items():
+        if device_kind.startswith(pfx):
+            return peaks.get(dtype)
+    return None  # MFU not meaningful for the host fallback
 
 
 def _problem_for(backend: str) -> dict:
@@ -129,51 +135,57 @@ def run_batch_bench(
     lam, alpha = 0.001, 1.0
     y = tr.init_item_factors(item_side, n_items, k, jax.random.PRNGKey(0))
 
-    def half(side, opp):
+    def half(side, opp, dtype):
         return tr.solve_side_blocked(
             opp, side.srows, side.scols, side.svals, side.slens, lam, alpha,
             block=side.block, features=k, implicit=True,
-            slot_chunk=side.slot_chunk,
+            slot_chunk=side.slot_chunk, dtype=dtype,
         )
 
-    # warmup: compiles both half-iteration programs (als_train's loop body)
-    t0 = time.perf_counter()
-    x = half(user_side, y)
-    y1 = half(item_side, x)
-    y1.block_until_ready()
-    record["compile_plus_first_iter_s"] = round(time.perf_counter() - t0, 2)
+    flops_per_iter = _useful_flops_per_iter(nnz, n_users, n_items, k)
 
-    # timed loop: full alternating iterations until max_iters or budget
-    iters = 0
-    t0 = time.perf_counter()
-    while iters < max_iters:
-        x = half(user_side, y)
-        y = half(item_side, x)
-        y.block_until_ready()
-        iters += 1
-        if time.perf_counter() - t0 > time_budget_s:
-            break
-    elapsed = time.perf_counter() - t0
-    x.block_until_ready()
+    def timed_loop(dtype: str, budget_s: float) -> dict:
+        # warmup: compiles both half-iteration programs (als_train's loop)
+        yy = y
+        t0 = time.perf_counter()
+        x = half(user_side, yy, dtype)
+        y1 = half(item_side, x, dtype)
+        y1.block_until_ready()
+        out = {"compile_plus_first_iter_s": round(time.perf_counter() - t0, 2)}
+        iters = 0
+        t0 = time.perf_counter()
+        while iters < max_iters:
+            x = half(user_side, yy, dtype)
+            yy = half(item_side, x, dtype)
+            yy.block_until_ready()
+            iters += 1
+            if time.perf_counter() - t0 > budget_s:
+                break
+        elapsed = time.perf_counter() - t0
+        out["value"] = round(nnz * iters / elapsed, 1)
+        out["elapsed_s"] = round(elapsed, 2)
+        out["iterations"] = iters
+        flops = flops_per_iter * iters
+        out["useful_tflops_per_s"] = round(flops / elapsed / 1e12, 3)
+        peak = _peak_for(device_kind, dtype)
+        if peak:
+            out["mfu"] = round(flops / elapsed / peak, 4)
+            out["mfu_peak_ref"] = f"{device_kind} {dtype} {peak / 1e12:.0f}e12"
+        return out
 
-    ratings_per_s = nnz * iters / elapsed
-    record["value"] = round(ratings_per_s, 1)
-    record["elapsed_s"] = round(elapsed, 2)
-    record["iterations"] = iters
+    start = time.perf_counter()
+    f32 = timed_loop("float32", time_budget_s)
+    record.update(f32)
     record["iterations_planned"] = max_iters
+    # bf16 inputs (MXU-native, f32 accumulation; quality gate:
+    # tests/test_als_quality.py::test_als_auc_bfloat16_compute) — run with
+    # whatever budget remains
+    remaining = time_budget_s - (time.perf_counter() - start)
+    if remaining > 10.0:
+        record["bf16"] = timed_loop("bfloat16", remaining)
     record["peak_rss_mb"] = (
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
     )
-
-    peak = next(
-        (v for pfx, v in _PEAK_F32.items() if device_kind.startswith(pfx)),
-        None,
-    )
-    flops = _useful_flops_per_iter(nnz, n_users, n_items, k) * iters
-    record["useful_tflops_per_s"] = round(flops / elapsed / 1e12, 3)
-    if peak:
-        record["mfu"] = round(flops / elapsed / peak, 4)
-        record["mfu_peak_ref"] = f"{device_kind} f32 {peak / 1e12:.0f}e12"
     return record
 
 

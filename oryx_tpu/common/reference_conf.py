@@ -169,6 +169,10 @@ oryx = {
     }
     no-known-items = false
     rescorer-provider-class = null
+    # Trainer matmul input precision: "float32" (default) or "bfloat16"
+    # (MXU-native: ~4x matmul rate + half the gather bandwidth on TPU;
+    # accumulation and solves stay float32 either way).
+    compute-dtype = "float32"
     decay = {
       factor = 1.0
       zero-threshold = 0.0

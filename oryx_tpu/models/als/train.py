@@ -193,12 +193,15 @@ def make_blocked_side(
 
 
 def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
-                 implicit, slot_chunk, yty):
+                 implicit, slot_chunk, yty, compute_dtype=jnp.float32):
     """Solve one row block's factors against fixed column factors ``y``.
 
     srow: (S,) block-local int32 in [0, block] (block = spill/padding);
     scols/svals: (S, T); returns (block, k). Peak memory
-    O(block·k² + slot_chunk·T·k).
+    O(block·k² + slot_chunk·T·k). ``y`` may arrive pre-cast to
+    ``compute_dtype`` (bfloat16 = MXU-native inputs, half the gather
+    bandwidth); Gramian/RHS accumulation stays float32 via
+    preferred_element_type, and the Cholesky solve is always float32.
     """
     k = features
     t = scols.shape[-1]
@@ -218,8 +221,14 @@ def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
             w = m
             coef = vs * m
         # per-slot Gramian: ONE batched MXU matmul, contraction over T
-        ga = jnp.einsum("st,sti,stj->sij", w, yg, yg)  # (Sc, k, k)
-        gb = jnp.einsum("st,sti->si", coef, yg)  # (Sc, k)
+        ga = jnp.einsum(
+            "st,sti,stj->sij", w.astype(compute_dtype), yg, yg,
+            preferred_element_type=jnp.float32,
+        )  # (Sc, k, k)
+        gb = jnp.einsum(
+            "st,sti->si", coef.astype(compute_dtype), yg,
+            preferred_element_type=jnp.float32,
+        )  # (Sc, k)
         seg = functools.partial(
             jax.ops.segment_sum, num_segments=block + 1, indices_are_sorted=True
         )
@@ -250,18 +259,22 @@ def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "features", "implicit", "slot_chunk")
+    jax.jit,
+    static_argnames=("block", "features", "implicit", "slot_chunk", "dtype"),
 )
 def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
-                       features, implicit, slot_chunk):
+                       features, implicit, slot_chunk, dtype="float32"):
     """One half-iteration, single device: lax.map over row blocks."""
     yty = (y.T @ y) if implicit else None  # (k,k) Gramian — one MXU matmul
+    cd = jnp.dtype(dtype)
+    ys = y.astype(cd) if cd != y.dtype else y  # one cast, gathered per chunk
 
     def one(args):
         r, c, v, ln = args
         return _solve_block(
-            y, r, c, v, ln, block=block, features=features, lam=lam,
+            ys, r, c, v, ln, block=block, features=features, lam=lam,
             alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
+            compute_dtype=cd,
         )
 
     out = jax.lax.map(one, (srows, scols, svals, slens))  # (n_blocks, block, k)
@@ -269,7 +282,8 @@ def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk):
+def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk,
+                    dtype="float32"):
     """jit(shard_map) for one half-iteration: blocks shard over ``row_axis``,
     opposite factors replicated, output factors row-partitioned (pinned by
     out_specs). Cached per (mesh, statics)."""
@@ -280,14 +294,18 @@ def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk):
     except ImportError:  # pragma: no cover — older jax
         from jax.experimental.shard_map import shard_map
 
+    cd = jnp.dtype(dtype)
+
     def local(y, srows, scols, svals, slens, lam, alpha):
         yty = (y.T @ y) if implicit else None
+        ys = y.astype(cd) if cd != y.dtype else y
 
         def one(args):
             r, c, v, ln = args
             return _solve_block(
-                y, r, c, v, ln, block=block, features=features, lam=lam,
+                ys, r, c, v, ln, block=block, features=features, lam=lam,
                 alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
+                compute_dtype=cd,
             )
 
         out = jax.lax.map(one, (srows, scols, svals, slens))
@@ -361,8 +379,12 @@ def als_train(
     row_axis: str | None = None,
     block: int | None = None,
     slot_width: int | None = None,
+    dtype: str = "float32",
 ):
     """Full alternating optimization; returns (X, Y) as jax arrays.
+
+    ``dtype`` sets the Gramian-matmul INPUT precision ("bfloat16" = MXU
+    native; accumulation and solves stay float32 regardless).
 
     Single-device (no mesh): returns exact-shape ``(n_users, k)``/
     ``(n_items, k)`` arrays.
@@ -384,6 +406,13 @@ def als_train(
 
     if iterations < 1:
         raise ValueError(f"iterations must be >= 1, got {iterations}")
+    if dtype not in ("float32", "bfloat16"):
+        # fail fast at the API boundary: a typo ("bf16") would otherwise
+        # surface deep inside a jitted solve, and a low-precision numpy
+        # dtype ("float16", "int8") would run and silently degrade factors
+        raise ValueError(
+            f"compute dtype must be 'float32' or 'bfloat16', got {dtype!r}"
+        )
 
     n_users, n_items = len(batch.users), len(batch.items)
     k = features
@@ -414,8 +443,10 @@ def als_train(
         u_arrays = put_side(user_side)
         i_arrays = put_side(item_side)
         y = jax.device_put(y, row_shard)
-        solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit, chunk_u)
-        solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit, chunk_i)
+        solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit,
+                                  chunk_u, dtype)
+        solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit,
+                                  chunk_i, dtype)
         x = None
         for _ in range(iterations):
             x = solve_u(y, *u_arrays, lam, alpha)
@@ -428,10 +459,12 @@ def als_train(
             y, user_side.srows, user_side.scols, user_side.svals,
             user_side.slens, lam, alpha,
             block=block_u, features=k, implicit=implicit, slot_chunk=chunk_u,
+            dtype=dtype,
         )
         y = solve_side_blocked(
             x, item_side.srows, item_side.scols, item_side.svals,
             item_side.slens, lam, alpha,
             block=block_i, features=k, implicit=implicit, slot_chunk=chunk_i,
+            dtype=dtype,
         )
     return x[:n_users], y[:n_items]

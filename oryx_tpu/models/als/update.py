@@ -42,6 +42,7 @@ class ALSUpdate(MLUpdate):
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.decay_factor = config.get_float("oryx.als.decay.factor")
         self.decay_zero_threshold = config.get_float("oryx.als.decay.zero-threshold")
+        self.compute_dtype = config.get_string("oryx.als.compute-dtype", "float32")
         self.hyper_params = [
             hp.from_config(config, "oryx.als.hyperparams.features"),
             hp.from_config(config, "oryx.als.hyperparams.lambda"),
@@ -88,6 +89,7 @@ class ALSUpdate(MLUpdate):
             key=rand.get_key(),
             mesh=mesh,
             row_axis=row_axis,
+            dtype=self.compute_dtype,
         )
         # mesh-path factors come back row-partitioned and padded to the block
         # boundary (train.als_train contract) — slice to exact size host-side

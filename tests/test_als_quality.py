@@ -40,13 +40,20 @@ def _synthetic_movielens(n_users=900, n_items=1600, n_ratings=100_000, rank=5, s
     return lines
 
 
-def test_als_auc_at_movielens_scale(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("compute_dtype", ["float32", "bfloat16"])
+def test_als_auc_at_movielens_scale(tmp_path, compute_dtype):
+    """bfloat16 = the MXU-native input path (f32 accumulation); it must hold
+    the same quality bar as float32."""
     rand.use_test_seed()
     config = cfg.overlay_on(
         {
             "oryx.als.iterations": 8,
             "oryx.als.hyperparams.features": 20,
             "oryx.als.hyperparams.lambda": 0.01,
+            "oryx.als.compute-dtype": compute_dtype,
             "oryx.ml.eval.test-fraction": 0.1,
         },
         cfg.get_default(),
@@ -59,4 +66,4 @@ def test_als_auc_at_movielens_scale(tmp_path):
     assert pmml is not None
     auc = update.evaluate(None, pmml, tmp_path, test, train)
     # mean AUC well above chance on structured preferences
-    assert auc > 0.75, f"AUC too low: {auc}"
+    assert auc > 0.75, f"{compute_dtype} AUC too low: {auc}"
