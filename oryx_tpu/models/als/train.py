@@ -341,9 +341,18 @@ def prepare_blocked(
     production uses."""
     n_users, n_items = len(batch.users), len(batch.items)
     auto = _auto_block(features) if block is None else block
-    # keep every device busy: no point in blocks wider than a device's share
-    block_u = max(32, min(auto, -(-n_users // ndev)))
-    block_i = max(32, min(auto, -(-n_items // ndev)))
+
+    def even_block(n_rows: int) -> int:
+        # divide rows EVENLY across the block count the budget implies (and
+        # keep every device busy): a block of exactly `auto` would leave the
+        # last block nearly empty while every block pads to the fullest
+        # one's slot count
+        n_blocks = max(1, -(-n_rows // max(32, min(auto, -(-n_rows // ndev)))))
+        n_blocks = -(-n_blocks // ndev) * ndev
+        return max(32, -(-n_rows // n_blocks))
+
+    block_u = even_block(n_users)
+    block_i = even_block(n_items)
     user_side = make_blocked_side(
         batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
         slot_width, ndev, features=features,
