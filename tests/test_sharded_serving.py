@@ -121,3 +121,24 @@ def test_sharded_how_many_exceeds_shard_rows():
     for g, w in zip(got, want):
         assert len(g) == 40
         assert [i for i, _ in g] == [i for i, _ in w]
+
+
+def test_sharded_snapshot_tracks_point_updates():
+    """Speed-layer UP point updates must flow through the incremental
+    snapshot onto the sharded scan: an updated item vector changes the
+    sharded top-N without a model reload."""
+    mesh = make_mesh(axes=("model",))
+    sharded, queries = _build(mesh, n_items=320)
+    q = queries[0]
+    base = sharded.top_n(q, 3)
+    # craft a vector that dominates the query direction, assign to a loser
+    winner_vec = (q / np.linalg.norm(q) * 50.0).astype(np.float32)
+    sharded.set_item_vector("i300", winner_vec)
+    got = sharded.top_n(q, 3)
+    assert got[0][0] == "i300", (base, got)
+    snap = sharded.y_snapshot()
+    assert snap.sharded_mat is not None  # still the multi-device scan
+    # appended NEW item also lands in the sharded scan
+    sharded.set_item_vector("fresh", (winner_vec * 2).astype(np.float32))
+    got2 = sharded.top_n(q, 3)
+    assert got2[0][0] == "fresh"
