@@ -388,3 +388,107 @@ def test_time_ordered_train_test_split():
     test_ts = [int(km.message.split(",")[3]) for km in test]
     assert len(test) == 2
     assert max(train_ts) < min(test_ts)
+
+
+class TestVectorizedIngest:
+    """The vectorized CSV ingest must be semantically IDENTICAL to the
+    general parse→decay→aggregate path (it is the data-loader hot path at
+    reference scale; ALSUpdate.java:326-423 semantics)."""
+
+    @staticmethod
+    def _slow(lines, implicit, **kw):
+        now = kw.pop("now_ms", 1_700_000_000_000)
+        inter = d.parse_lines(lines, now)
+        inter = d.decay(inter, kw.get("decay_factor", 1.0),
+                        kw.get("decay_zero_threshold", 0.0), now)
+        agg = d.aggregate(inter, implicit, kw.get("log_strength", False),
+                          kw.get("epsilon", 1.0e-5))
+        return d.build_rating_batch(agg)
+
+    @staticmethod
+    def _assert_same(fast, slow):
+        assert fast.users.index_to_id == slow.users.index_to_id
+        assert fast.items.index_to_id == slow.items.index_to_id
+        def canon(b):
+            return sorted(zip(b.rows.tolist(), b.cols.tolist(),
+                              np.round(b.vals, 5).tolist()))
+        assert canon(fast) == canon(slow)
+
+    def _check(self, lines, implicit, **kw):
+        kw.setdefault("now_ms", 1_700_000_000_000)
+        fast = d._prepare_vectorized(
+            list(lines), implicit, kw.get("decay_factor", 1.0),
+            kw.get("decay_zero_threshold", 0.0), kw.get("log_strength", False),
+            kw.get("epsilon", 1.0e-5), kw["now_ms"],
+        )
+        assert fast is not None, "expected the vectorized path"
+        self._assert_same(fast, self._slow(list(lines), implicit, **kw))
+
+    def test_implicit_dups_and_deletes(self):
+        rng = np.random.default_rng(0)
+        lines = [
+            f"u{rng.integers(0, 20)},i{rng.integers(0, 15)},"
+            f"{rng.choice(['1', '2.5', '-1', ''])},{1000 + n}"
+            for n in range(400)
+        ]
+        self._check(lines, implicit=True)
+
+    def test_explicit_last_wins(self):
+        rng = np.random.default_rng(1)
+        ts = rng.permutation(400)
+        lines = [
+            f"u{rng.integers(0, 10)},i{rng.integers(0, 8)},"
+            f"{rng.integers(1, 6)},{int(t)}"
+            for t in ts
+        ]
+        self._check(lines, implicit=False)
+
+    def test_decay_threshold_log_and_short_rows(self):
+        now = 1_700_000_000_000
+        day = 86_400_000
+        lines = [
+            "a,x", "b,y,3", f"c,z,4,{now - 3 * day}", f"a,y,2,{now - 10 * day}",
+        ]
+        for implicit in (True, False):
+            self._check(lines, implicit, decay_factor=0.9,
+                        decay_zero_threshold=0.5, log_strength=True,
+                        now_ms=now)
+
+    def test_delete_only_pairs_drop_ids_from_mappings(self):
+        lines = ["only-del,gone,,5", "keep,stay,1,6"]
+        self._check(lines, implicit=True)
+        fast = d.prepare(lines, implicit=True, now_ms=10)
+        assert fast.users.index_to_id == ["keep"]
+        assert fast.items.index_to_id == ["stay"]
+
+    def test_fallback_on_json_quoted_and_bad_lines(self):
+        assert d._prepare_vectorized(
+            ['["u","i","1"]'], True, 1.0, 0.0, False, 1e-5, 10) is None
+        assert d._prepare_vectorized(
+            ['"u",i,1'], True, 1.0, 0.0, False, 1e-5, 10) is None
+        assert d._prepare_vectorized(
+            ["solo"], True, 1.0, 0.0, False, 1e-5, 10) is None
+        assert d._prepare_vectorized(
+            ["u,i,notanumber"], True, 1.0, 0.0, False, 1e-5, 10) is None
+        assert d._prepare_vectorized(
+            ["u,i,1,"], True, 1.0, 0.0, False, 1e-5, 10) is None
+        # prepare() still answers via the general parser
+        batch = d.prepare(['["ju","ji","2"]', "cu,ci,3"], implicit=True)
+        assert batch.nnz == 2
+
+    def test_prepare_uses_fast_path_result(self):
+        lines = [f"u{i % 7},i{i % 5},1,{i}" for i in range(100)]
+        fast = d.prepare(lines, implicit=True, now_ms=500)
+        slow = self._slow(lines, True, now_ms=500)
+        self._assert_same(fast, slow)
+
+    def test_fallback_on_nonfinite_ts_and_padded_json(self):
+        # 'nan'/'inf' timestamps are parse errors in the general parser
+        assert d._prepare_vectorized(
+            ["u,i,2,nan"], True, 1.0, 0.0, False, 1e-5, 10) is None
+        assert d.prepare(["u,i,2,inf"], implicit=True, now_ms=10).nnz == 0
+        # JSON with leading whitespace must not be misparsed as CSV
+        assert d._prepare_vectorized(
+            [' ["u","i","2"]'], True, 1.0, 0.0, False, 1e-5, 10) is None
+        batch = d.prepare([' ["ju","ji","2"]'], implicit=True, now_ms=10)
+        assert batch.users.index_to_id == ["ju"]
