@@ -178,15 +178,87 @@ def run_batch_bench(
     record.update(f32)
     record["iterations_planned"] = max_iters
     # bf16 inputs (MXU-native, f32 accumulation; quality gate:
-    # tests/test_als_quality.py::test_als_auc_bfloat16_compute) — run with
-    # whatever budget remains
+    # tests/test_als_quality.py) — run with whatever budget remains
     remaining = time_budget_s - (time.perf_counter() - start)
     if remaining > 10.0:
         record["bf16"] = timed_loop("bfloat16", remaining)
     record["peak_rss_mb"] = (
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
     )
+    # the other two batch-tier phases of the north-star loop (train →
+    # speed-update → serve): CSV ingest and speed-layer fold-in
+    for name, fn in (("ingest", run_ingest_bench), ("speed", run_speed_bench)):
+        try:
+            record[name] = fn()
+        except Exception as e:  # noqa: BLE001 — optional sections
+            record[name] = {"error": f"{type(e).__name__}: {e}"}
     return record
+
+
+def run_ingest_bench(n_lines: int = 1_000_000) -> dict:
+    """Data-loader throughput: plain-CSV lines → aggregated, indexed COO
+    (the vectorized prepare() path; reference ALSUpdate.java:326-423)."""
+    from oryx_tpu.models.als import data as als_data
+
+    rng = np.random.default_rng(7)
+    us = rng.integers(0, 200_000, n_lines)
+    its = rng.integers(0, 20_000, n_lines)
+    lines = [f"u{u},i{i},1,{t}" for u, i, t in zip(us, its, range(n_lines))]
+    t0 = time.perf_counter()
+    batch = als_data.prepare(lines, implicit=True, now_ms=n_lines + 1)
+    elapsed = time.perf_counter() - t0
+    return {
+        "value": round(n_lines / elapsed, 1),
+        "unit": "lines/s",
+        "elapsed_s": round(elapsed, 2),
+        "nnz": batch.nnz,
+    }
+
+
+def run_speed_bench(n_model_users: int = 100_000, n_model_items: int = 20_000,
+                    microbatch: int = 50_000, features: int = FEATURES) -> dict:
+    """Speed-tier fold-in throughput: one microbatch of interactions through
+    ALSSpeedModelManager.build_updates (batched two-sided fold-in; reference
+    ALSSpeedModelManager.java:135-221)."""
+    from oryx_tpu.api.keymessage import KeyMessage
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.models.als.speed import ALSSpeedModel, ALSSpeedModelManager
+
+    rng = np.random.default_rng(9)
+    manager = ALSSpeedModelManager(cfg.get_default())
+    model = ALSSpeedModel(features, True)
+    model.x.bulk_load(
+        [f"u{i}" for i in range(n_model_users)],
+        rng.standard_normal((n_model_users, features)).astype(np.float32),
+    )
+    model.y.bulk_load(
+        [f"i{i}" for i in range(n_model_items)],
+        rng.standard_normal((n_model_items, features)).astype(np.float32),
+    )
+    manager.model = model
+
+    def batch_of(n, seed):
+        r = np.random.default_rng(seed)
+        return [
+            KeyMessage(None, f"u{u},i{i},1,{t}")
+            for t, (u, i) in enumerate(zip(
+                r.integers(0, n_model_users, n),
+                r.integers(0, n_model_items, n),
+            ))
+        ]
+
+    ups = manager.build_updates(batch_of(2_000, 1))  # warm solvers + compile
+    assert ups
+    data = batch_of(microbatch, 2)
+    t0 = time.perf_counter()
+    ups = manager.build_updates(data)
+    elapsed = time.perf_counter() - t0
+    return {
+        "value": round(microbatch / elapsed, 1),
+        "unit": "interactions/s",
+        "elapsed_s": round(elapsed, 2),
+        "updates_emitted": len(ups),
+    }
 
 
 def main() -> None:

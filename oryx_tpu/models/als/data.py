@@ -200,6 +200,8 @@ def _prepare_vectorized(
     tss: list = []
     now_s = str(now_ms)
     for ln in lines:
+        if ln and ln[-1] in "\r\n":
+            ln = ln.rstrip("\r\n")  # the csv parser strips line terminators
         if not ln or ln[0] == "[" or '"' in ln:
             return None
         if ln[0].isspace() and ln.lstrip()[:1] == "[":
@@ -224,8 +226,10 @@ def _prepare_vectorized(
         tsf = np.asarray(tss, dtype=np.float64)
     except ValueError:
         return None  # non-numeric strength/timestamp → general parser
-    if not np.isfinite(tsf).all():
-        return None  # 'nan'/'inf' timestamps are parse errors downstream
+    if not np.isfinite(tsf).all() or not (np.abs(tsf) < 2.0**63).all():
+        # 'nan'/'inf' are parse errors downstream; >= 2^63 would wrap in the
+        # int64 cast and invert last-by-timestamp ordering
+        return None
     ts = tsf.astype(np.int64)
 
     # decay (decayRating:383-389): per-day exponential for past timestamps

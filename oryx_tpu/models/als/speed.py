@@ -132,33 +132,36 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         model.xtx_cache.compute_now()
         model.yty_cache.compute_now()
 
-        interactions = als_data.parse_lines([km.message for km in new_data])
-        # aggregate() sorts by timestamp internally (data.py)
-        agg = als_data.aggregate(
-            interactions, self.implicit, self.log_strength, self.epsilon
+        # parse + aggregate through the (vectorized when plain-CSV) ingest
+        # pipeline — identical semantics to aggregate() with no decay
+        batch = als_data.prepare(
+            [km.message for km in new_data], self.implicit,
+            log_strength=self.log_strength, epsilon=self.epsilon,
         )
-        if not agg:
+        if batch.nnz == 0:
             return []
         yty_solver = model.yty_cache.get(blocking=True)
         xtx_solver = model.xtx_cache.get(blocking=True)
 
-        # gather the microbatch's vectors once, then fold in EVERY interaction
-        # with one batched solve per side — B k×k solves collapse into two
-        # stacked-RHS matmuls instead of a per-interaction host loop
-        # (the TPU answer to ALSSpeedModelManager.java:198-220's parallelStream)
-        pairs = list(agg.items())
-        B, k = len(pairs), model.features
+        # gather the microbatch's vectors once (one read lock per store),
+        # then fold in EVERY interaction with one batched solve per side —
+        # B k×k solves collapse into two stacked-RHS matmuls instead of a
+        # per-interaction host loop (the TPU answer to
+        # ALSSpeedModelManager.java:198-220's parallelStream)
+        u_ids, i_ids = batch.users.index_to_id, batch.items.index_to_id
+        users_l = [u_ids[r] for r in batch.rows.tolist()]
+        items_l = [i_ids[c] for c in batch.cols.tolist()]
+        pairs = list(zip(users_l, items_l))
+        values = batch.vals.astype(np.float64)
+        B, k = batch.nnz, model.features
         xus = np.zeros((B, k), dtype=np.float32)
         yis = np.zeros((B, k), dtype=np.float32)
         has_xu = np.zeros(B, dtype=bool)
         has_yi = np.zeros(B, dtype=bool)
-        values = np.empty(B, dtype=np.float64)
-        for b, ((user, item), value) in enumerate(pairs):
-            values[b] = value
-            xu = model.x.get_vector(user)
-            yi = model.y.get_vector(item)
+        for b, xu in enumerate(model.x.get_vectors(users_l)):
             if xu is not None:
                 xus[b], has_xu[b] = xu, True
+        for b, yi in enumerate(model.y.get_vectors(items_l)):
             if yi is not None:
                 yis[b], has_yi[b] = yi, True
 
@@ -178,13 +181,13 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
         # serving's known-items live (ALSSpeedModelManager.java:223-231);
         # omitted entirely under oryx.als.no-known-items
         updates: list[str] = []
-        for b, ((user, item), _) in enumerate(pairs):
+        for b, (user, item) in enumerate(pairs):
             if new_x is not None and changed_x[b]:
-                vec = [float(v) for v in new_x[b]]
+                vec = new_x[b].tolist()
                 up = ["X", user, vec] if self.no_known_items else ["X", user, vec, [item]]
                 updates.append(json.dumps(up))
             if new_y is not None and changed_y[b]:
-                vec = [float(v) for v in new_y[b]]
+                vec = new_y[b].tolist()
                 up = ["Y", item, vec] if self.no_known_items else ["Y", item, vec, [user]]
                 updates.append(json.dumps(up))
         return updates

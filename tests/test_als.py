@@ -492,3 +492,17 @@ class TestVectorizedIngest:
             [' ["u","i","2"]'], True, 1.0, 0.0, False, 1e-5, 10) is None
         batch = d.prepare([' ["ju","ji","2"]'], implicit=True, now_ms=10)
         assert batch.users.index_to_id == ["ju"]
+
+    def test_crlf_and_huge_timestamps(self):
+        # CRLF terminators strip like the csv parser does
+        fast = d._prepare_vectorized(
+            ["u,i,2,5\r", "a,b,3,6\r\n"], True, 1.0, 0.0, False, 1e-5, 10)
+        assert fast is not None and fast.items.index_to_id == ["b", "i"]
+        self._check(["u,i,2,5\r", "a,b,3,6\r\n"], implicit=True, now_ms=10)
+        # timestamps that would wrap int64 fall back to the general parser
+        assert d._prepare_vectorized(
+            ["u,i,1,1e19", "u,i,5,100"], False, 1.0, 0.0, False, 1e-5, 10
+        ) is None
+        slow_equiv = d.prepare(["u,i,1,1e19", "u,i,5,100"], implicit=False,
+                               now_ms=10)
+        assert slow_equiv.vals.tolist() == [1.0]  # 1e19 is the last write
