@@ -389,6 +389,30 @@ def main() -> None:
     if record["batch"].get("backend") == "tpu" and "error" not in record["batch"]:
         _persist_last_tpu({"batch": record["batch"]})
 
+    # multi-device scaling datapoint: the mesh-sharded trainer over a
+    # virtual 8-device host mesh (the multi-chip production path, minus the
+    # chips — tests assert equality with single-device; this measures it)
+    mesh_env = dict(os.environ)
+    flags = mesh_env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        mesh_env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    mesh_env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench_batch.py"), "--mesh"],
+            capture_output=True, text=True, timeout=300, env=mesh_env,
+        )
+        record["batch_mesh8"] = (
+            json.loads(proc.stdout.strip().splitlines()[-1])
+            if proc.returncode == 0
+            else {"error": f"exit {proc.returncode}",
+                  "stderr_tail": proc.stderr[-300:]}
+        )
+    except Exception as e:  # noqa: BLE001
+        record["batch_mesh8"] = {"error": f"{type(e).__name__}: {e}"}
+
     # the most recent on-chip evidence rides along with provenance, so a
     # tunnel flap during THIS run cannot erase the round's TPU record
     last = _load_last_tpu()

@@ -270,9 +270,61 @@ def run_speed_bench(n_model_users: int = 100_000, n_model_items: int = 20_000,
     }
 
 
+def run_mesh_bench(features: int = FEATURES) -> dict:
+    """Mesh-sharded trainer at bench scale: the block axis shards over every
+    local device (run under --xla_force_host_platform_device_count this is
+    the multi-chip scaling datapoint; on real multi-chip hardware it is the
+    production path). Uses the public als_train mesh entry end-to-end."""
+    import jax
+
+    from oryx_tpu.common.executils import pin_cpu_platform_if_forced
+
+    pin_cpu_platform_if_forced()
+
+    from oryx_tpu.models.als import train as tr
+    from oryx_tpu.models.als.data import RatingBatch
+    from oryx_tpu.parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    backend = jax.default_backend()
+    prob = _problem_for("cpu")  # mesh datapoint uses the always-fits shape
+    n_users, n_items, nnz = prob["n_users"], prob["n_items"], prob["nnz"]
+    iterations = prob["iterations"]
+    rng = np.random.default_rng(42)
+    batch = RatingBatch(
+        rng.integers(0, n_users, nnz).astype(np.int32),
+        rng.integers(0, n_items, nnz).astype(np.int32),
+        np.ones(nnz, dtype=np.float32),
+        _FakeIDs(n_users), _FakeIDs(n_items),
+    )
+    mesh = make_mesh(axes=("model",))
+    kwargs = dict(features=features, lam=0.001, alpha=1.0, implicit=True,
+                  mesh=mesh, row_axis="model", key=jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    x, _ = tr.als_train(batch, iterations=1, **kwargs)  # compile + pack
+    x.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    x, y = tr.als_train(batch, iterations=iterations, **kwargs)
+    x.block_until_ready()
+    y.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": f"als_batch_train_mesh{ndev}_{nnz // 1_000_000}M_{features}f",
+        "value": round(nnz * iterations / elapsed, 1),
+        "unit": "ratings/s",
+        "elapsed_s": round(elapsed, 2),
+        "iterations": iterations,
+        "n_devices": ndev,
+        "backend": backend,
+        "compile_plus_first_iter_s": round(compile_s, 2),
+    }
+
+
 def main() -> None:
     try:
-        print(json.dumps(run_batch_bench()))
+        fn = run_mesh_bench if "--mesh" in sys.argv else run_batch_bench
+        print(json.dumps(fn()))
     except Exception as e:  # noqa: BLE001 — always emit a JSON line
         print(json.dumps({"metric": "als_batch_train_throughput",
                           "error": f"{type(e).__name__}: {e}"}))
