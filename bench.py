@@ -340,7 +340,8 @@ def _http_client_proc(args) -> tuple:
     return asyncio.run(drive())
 
 
-def _section_subproc(argv: list, timeout: int, force_cpu: bool,
+def _section_subproc(argv: list, timeout: int, force_cpu: bool = False,
+                     env: "dict | None" = None,
                      metric: str) -> dict:
     """One bench section in its own subprocess with its own timeout: a hang
     or crash costs that section, never the whole benchmark (and batch vs
@@ -350,7 +351,7 @@ def _section_subproc(argv: list, timeout: int, force_cpu: bool,
         proc = subprocess.run(
             [sys.executable, *argv],
             capture_output=True, text=True, timeout=timeout,
-            env=_subproc_env(force_cpu),
+            env=env if env is not None else _subproc_env(force_cpu),
         )
         if proc.returncode != 0:
             return {"metric": metric, "error": f"exit {proc.returncode}",
@@ -399,19 +400,10 @@ def main() -> None:
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
     mesh_env["JAX_PLATFORMS"] = "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(here, "bench_batch.py"), "--mesh"],
-            capture_output=True, text=True, timeout=300, env=mesh_env,
-        )
-        record["batch_mesh8"] = (
-            json.loads(proc.stdout.strip().splitlines()[-1])
-            if proc.returncode == 0
-            else {"error": f"exit {proc.returncode}",
-                  "stderr_tail": proc.stderr[-300:]}
-        )
-    except Exception as e:  # noqa: BLE001
-        record["batch_mesh8"] = {"error": f"{type(e).__name__}: {e}"}
+    record["batch_mesh8"] = _section_subproc(
+        [os.path.join(here, "bench_batch.py"), "--mesh"],
+        300, env=mesh_env, metric="als_batch_train_mesh",
+    )
 
     # the most recent on-chip evidence rides along with provenance, so a
     # tunnel flap during THIS run cannot erase the round's TPU record

@@ -83,6 +83,21 @@ def pin_cpu_platform_if_forced() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def device_sync(x) -> float:
+    """Block until ``x``'s computation has actually finished on the device.
+
+    ``Array.block_until_ready()`` is a silent no-op on some tunneled
+    accelerator backends (the experimental axon platform among them), which
+    makes any wall-clock timed against it fiction. A host fetch cannot lie:
+    reduce on-device to one scalar (cheap relative to anything worth timing,
+    and dependent on every shard of ``x``), then pull that scalar across the
+    host boundary. Returns the fetched value so callers can also use it as a
+    NaN canary."""
+    import jax.numpy as jnp
+
+    return float(jnp.sum(x))
+
+
 def collect_speculative(
     num_tasks: int,
     fn: "Callable[[int, int], T]",

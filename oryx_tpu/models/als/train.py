@@ -114,7 +114,10 @@ def make_blocked_side(
     histogram, reused for the slot layout); ``slot_chunk=None`` then sizes
     the scan chunk from T and ``features`` to stay inside the transient
     budget."""
-    order = np.argsort(rows, kind="stable")
+    # sort by (row, col): row-major for contiguous slots, column-ascending
+    # within each row so the per-slot gathers of the opposite factors walk
+    # HBM in address order instead of randomly
+    order = np.lexsort((cols, rows))
     r = rows[order].astype(np.int64)
     c = cols[order].astype(np.int32)
     v = vals[order].astype(np.float32)
@@ -157,10 +160,12 @@ def make_blocked_side(
     sblock = srow_f // block
     bounds = np.searchsorted(sblock, np.arange(n_blocks + 1, dtype=np.int64))
     max_s = int(np.diff(bounds).max()) if total_slots else 0
-    # cap the chunk at ~1/8 of the fullest block so rounding S up to a chunk
-    # multiple wastes at most ~12% (a chunk comparable to S can double it)
-    slot_chunk = max(16, min(slot_chunk, max(64, -(-max(max_s, 1) // 8))))
-    s_len = max(slot_chunk, -(-max(max_s, 1) // slot_chunk) * slot_chunk)
+    # fewest scan steps that fit the transient budget, with the chunk sized
+    # to divide S exactly: sequential chunk steps are the TPU's enemy, and a
+    # budget-sized chunk that doesn't divide S would pad S up to a multiple
+    n_chunks = max(1, -(-max(max_s, 1) // slot_chunk))
+    slot_chunk = max(16, -(-max(max_s, 1) // n_chunks))
+    s_len = n_chunks * slot_chunk
 
     # Slot packing bounds skew damage (a hot row just spans more slots), but
     # uneven *block* slot counts still pad every block to the fullest one;
@@ -193,7 +198,8 @@ def make_blocked_side(
 
 
 def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
-                 implicit, slot_chunk, yty, compute_dtype=jnp.float32):
+                 implicit, slot_chunk, yty, compute_dtype=jnp.float32,
+                 spd_kernel=False):
     """Solve one row block's factors against fixed column factors ``y``.
 
     srow: (S,) block-local int32 in [0, block] (block = spill/padding);
@@ -252,19 +258,29 @@ def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
         big_a = big_a + yty[None, :, :]
     big_a = big_a + reg[:, None, None] * eye[None, :, :]
 
-    chol = jax.scipy.linalg.cholesky(big_a + 1e-6 * eye[None], lower=True)
-    x = jax.scipy.linalg.cho_solve((chol, True), big_b[..., None])[..., 0]
+    big_a = big_a + 1e-6 * eye[None]
+    if spd_kernel:
+        # Pallas Gauss-Jordan: k elimination steps against VMEM instead of
+        # XLA cholesky's ~3k full-operand HBM passes (see pallas_kernels)
+        from oryx_tpu.ops.pallas_kernels import spd_solve_batched
+
+        x = spd_solve_batched(big_a, big_b, interpret=False)
+    else:
+        chol = jax.scipy.linalg.cholesky(big_a, lower=True)
+        x = jax.scipy.linalg.cho_solve((chol, True), big_b[..., None])[..., 0]
     # rows with no interactions have no factor (reference: absent IDs)
     return jnp.where((cnt > 0)[:, None], x, 0.0)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block", "features", "implicit", "slot_chunk", "dtype"),
+    static_argnames=(
+        "block", "features", "implicit", "slot_chunk", "dtype", "spd_kernel",
+    ),
 )
-def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
-                       features, implicit, slot_chunk, dtype="float32"):
-    """One half-iteration, single device: lax.map over row blocks."""
+def _solve_side_blocked_jit(y, srows, scols, svals, slens, lam, alpha, *,
+                            block, features, implicit, slot_chunk, dtype,
+                            spd_kernel):
     yty = (y.T @ y) if implicit else None  # (k,k) Gramian — one MXU matmul
     cd = jnp.dtype(dtype)
     ys = y.astype(cd) if cd != y.dtype else y  # one cast, gathered per chunk
@@ -274,16 +290,49 @@ def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
         return _solve_block(
             ys, r, c, v, ln, block=block, features=features, lam=lam,
             alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
-            compute_dtype=cd,
+            compute_dtype=cd, spd_kernel=spd_kernel,
         )
 
     out = jax.lax.map(one, (srows, scols, svals, slens))  # (n_blocks, block, k)
     return out.reshape(-1, features)
 
 
+def _use_spd_kernel(y=None, mesh=None) -> bool:
+    """True when the solve will actually run on TPU. Decided from the target
+    devices (the mesh's, or the operand's), NOT ``jax.default_backend()``:
+    under the axon site hook the process default can say "tpu" while the
+    computation is pinned to the forced-host CPU platform (and vice versa
+    after ``jax.config.update("jax_platforms", ...)``)."""
+    if mesh is not None:
+        return mesh.devices.flat[0].platform == "tpu"
+    if y is not None and hasattr(y, "devices"):
+        try:
+            return next(iter(y.devices())).platform == "tpu"
+        except Exception:  # noqa: BLE001 — tracers etc.: fall through
+            pass
+    return jax.default_backend() == "tpu"
+
+
+def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
+                       features, implicit, slot_chunk, dtype="float32",
+                       spd_kernel: "bool | None" = None):
+    """One half-iteration, single device: lax.map over row blocks.
+
+    ``spd_kernel=None`` picks the Pallas Gauss-Jordan solver on TPU and the
+    LAPACK-backed cholesky path elsewhere (jit decisions are static, so the
+    backend is resolved here at call time)."""
+    if spd_kernel is None:
+        spd_kernel = _use_spd_kernel(y=y)
+    return _solve_side_blocked_jit(
+        y, srows, scols, svals, slens, lam, alpha, block=block,
+        features=features, implicit=implicit, slot_chunk=slot_chunk,
+        dtype=dtype, spd_kernel=bool(spd_kernel),
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk,
-                    dtype="float32"):
+                    dtype="float32", spd_kernel=False):
     """jit(shard_map) for one half-iteration: blocks shard over ``row_axis``,
     opposite factors replicated, output factors row-partitioned (pinned by
     out_specs). Cached per (mesh, statics)."""
@@ -305,7 +354,7 @@ def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk,
             return _solve_block(
                 ys, r, c, v, ln, block=block, features=features, lam=lam,
                 alpha=alpha, implicit=implicit, slot_chunk=slot_chunk, yty=yty,
-                compute_dtype=cd,
+                compute_dtype=cd, spd_kernel=spd_kernel,
             )
 
         out = jax.lax.map(one, (srows, scols, svals, slens))
@@ -452,10 +501,11 @@ def als_train(
         u_arrays = put_side(user_side)
         i_arrays = put_side(item_side)
         y = jax.device_put(y, row_shard)
+        use_spd = _use_spd_kernel(mesh=mesh)
         solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit,
-                                  chunk_u, dtype)
+                                  chunk_u, dtype, use_spd)
         solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit,
-                                  chunk_i, dtype)
+                                  chunk_i, dtype, use_spd)
         x = None
         for _ in range(iterations):
             x = solve_u(y, *u_arrays, lam, alpha)
