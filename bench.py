@@ -317,23 +317,32 @@ def _http_client_proc(args) -> tuple:
                     assert resp.status == 200, resp.status
                     await resp.read()
 
-            # ramp: one request per worker before the timed window opens
+            # ramp: one request per worker before any window opens
             await asyncio.gather(*[
                 get(f"u{i % n_users}") for i in range(concurrency)
             ])
-            t0 = time.perf_counter()
-            stop_at = t0 + duration_s
             counter = {"i": 0}
 
-            async def worker():
+            async def worker(stop_at, record):
                 while time.perf_counter() < stop_at:
                     counter["i"] += 1
                     u = f"u{counter['i'] % n_users}"
                     t1 = time.perf_counter()
                     await get(u)
-                    lat.append(time.perf_counter() - t1)
+                    if record is not None:
+                        record.append(time.perf_counter() - t1)
 
-            await asyncio.gather(*[worker() for _ in range(concurrency)])
+            # untimed warm phase at full concurrency: first-time XLA
+            # compiles of each coalesced (pow2) batch size happen HERE, so
+            # the timed window below measures steady state, not compiles
+            warm_stop = time.perf_counter() + duration_s * 0.8
+            await asyncio.gather(*[
+                worker(warm_stop, None) for _ in range(concurrency)
+            ])
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                worker(t0 + duration_s, lat) for _ in range(concurrency)
+            ])
             elapsed = time.perf_counter() - t0
         return lat, elapsed
 
@@ -341,8 +350,7 @@ def _http_client_proc(args) -> tuple:
 
 
 def _section_subproc(argv: list, timeout: int, force_cpu: bool = False,
-                     env: "dict | None" = None,
-                     metric: str) -> dict:
+                     env: "dict | None" = None, *, metric: str) -> dict:
     """One bench section in its own subprocess with its own timeout: a hang
     or crash costs that section, never the whole benchmark (and batch vs
     serving are separate processes in the lambda architecture anyway — a

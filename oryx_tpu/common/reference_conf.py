@@ -112,6 +112,10 @@ oryx = {
       # thread-fanned partition scans. 0 disables.
       coalesce-window-ms = 1.0
       coalesce-max-batch = 256
+      # Device calls allowed in flight at once. While one is out, arrivals
+      # queue and flush on its completion (batch-while-busy), so batch size
+      # tracks arrival-rate x device-latency; 2 overlaps transfer/compute.
+      coalesce-inflight = 2
     }
   }
 

@@ -261,10 +261,12 @@ def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
     big_a = big_a + 1e-6 * eye[None]
     if spd_kernel:
         # Pallas Gauss-Jordan: k elimination steps against VMEM instead of
-        # XLA cholesky's ~3k full-operand HBM passes (see pallas_kernels)
+        # XLA cholesky's ~3k full-operand HBM passes (see pallas_kernels).
+        # interpret=None: compiled on TPU, emulated elsewhere — which is
+        # what lets the CPU suite test this exact path (test_als.py)
         from oryx_tpu.ops.pallas_kernels import spd_solve_batched
 
-        x = spd_solve_batched(big_a, big_b, interpret=False)
+        x = spd_solve_batched(big_a, big_b)
     else:
         chol = jax.scipy.linalg.cholesky(big_a, lower=True)
         x = jax.scipy.linalg.cho_solve((chol, True), big_b[..., None])[..., 0]

@@ -126,7 +126,12 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
     # where dims pad to (8-sublane, 128-lane) tiles on TPU; the scoped-vmem
     # stack limit is 16 MB, so budget ~4 MB for the largest buffer
     k_padded = _pad_dim(k, 8) * _pad_dim(k + 1, _LANE)
-    tile_b = max(8, min(256, ((7 << 17) // max(1, k_padded)) & ~7))
+    tile_b = min(256, ((7 << 17) // max(1, k_padded)) & ~7)
+    if tile_b < 8:
+        # k so large (~>450 features) that even an 8-row tile overflows the
+        # scoped-VMEM stack: fall back to XLA's cholesky rather than fail
+        chol = jax.scipy.linalg.cholesky(a, lower=True)
+        return jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
     n_pad = _pad_dim(max(n, 1), tile_b)
     if n_pad != n:
         eye = jnp.broadcast_to(jnp.eye(k, dtype=jnp.float32),

@@ -68,6 +68,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
         app[rsrc.COALESCER_KEY] = TopNCoalescer(
             window_ms,
             config.get_int("oryx.serving.compute.coalesce-max-batch", 256),
+            config.get_int("oryx.serving.compute.coalesce-inflight", 2),
         )
 
     modules = list(DEFAULT_RESOURCES)

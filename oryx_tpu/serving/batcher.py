@@ -46,14 +46,31 @@ class _Pending:
 class TopNCoalescer:
     """Gathers concurrent top-N requests into one batched device call.
 
+    Batch-while-busy: when no device call is in flight a request flushes
+    after at most ``window_ms``; while calls are in flight new arrivals
+    simply accumulate and the completion of a call flushes whatever queued
+    behind it. Under closed-loop clients (each awaiting its response before
+    sending the next request) this makes the batch size converge on
+    arrival-rate × device-latency automatically — a fixed window would
+    degenerate to one-request batches the moment latency exceeds it, paying
+    a full device round-trip per request. ``max_inflight > 1`` keeps the
+    pipe full by overlapping one batch's host/transfer time with another's
+    compute.
+
     One instance per serving app; requests against different model objects
     (a MODEL handoff mid-flight) are grouped by model identity at flush."""
 
-    def __init__(self, window_ms: float = 1.0, max_batch: int = 256):
+    def __init__(self, window_ms: float = 1.0, max_batch: int = 256,
+                 max_inflight: int = 2):
         self.window_s = window_ms / 1000.0
-        self.max_batch = max_batch
+        # floor to a power of two: batches pad up to a pow2 for stable jit
+        # signatures, and padding must never exceed the configured cap
+        # (the operator tuned it to bound device memory)
+        self.max_batch = 1 << max(0, max(1, max_batch).bit_length() - 1)
+        self.max_inflight = max(1, max_inflight)
         self._pending: list[tuple[object, _Pending]] = []
         self._flusher: asyncio.TimerHandle | None = None
+        self._inflight = 0
 
     async def top_n(self, model, query_vec, how_many: int, offset: int = 0,
                     allowed=None, excluded=None) -> list:
@@ -64,28 +81,49 @@ class TopNCoalescer:
             np.asarray(query_vec, dtype=np.float32), how_many, offset,
             allowed, excluded, fut,
         )))
+        self._maybe_flush(loop)
+        return await fut
+
+    def _maybe_flush(self, loop) -> None:
+        if not self._pending or self._inflight >= self.max_inflight:
+            return  # an in-flight completion will re-trigger
         if len(self._pending) >= self.max_batch:
             self._flush(loop)
         elif self._flusher is None:
             self._flusher = loop.call_later(self.window_s,
                                             lambda: self._flush(loop))
-        return await fut
 
     def _flush(self, loop) -> None:
         if self._flusher is not None:
             self._flusher.cancel()
             self._flusher = None
-        batch, self._pending = self._pending, []
+        if self._inflight >= self.max_inflight:
+            return  # raced with a slower flush path; completion re-triggers
+        batch = self._pending[:self.max_batch]
+        self._pending = self._pending[self.max_batch:]
         if not batch:
             return
         by_model: dict[int, tuple[object, list[_Pending]]] = {}
         for model, p in batch:
             by_model.setdefault(id(model), (model, []))[1].append(p)
-        for model, group in by_model.values():
+        # a flush spanning several model objects (MODEL handoff mid-flight)
+        # must still honor max_inflight: dispatch while slots remain and
+        # push the rest back to the queue front for the next completion
+        groups = list(by_model.values())
+        while groups and self._inflight < self.max_inflight:
+            model, group = groups.pop(0)
+            self._inflight += 1
             loop.run_in_executor(None, self._execute, loop, model, group)
+        for model, group in reversed(groups):
+            self._pending[:0] = [(model, p) for p in group]
+        if self._pending:
+            self._maybe_flush(loop)
 
-    @staticmethod
-    def _execute(loop, model, group: list[_Pending]) -> None:
+    def _done(self, loop) -> None:
+        self._inflight -= 1
+        self._maybe_flush(loop)
+
+    def _execute(self, loop, model, group: list[_Pending]) -> None:
         """Executor thread: ONE batched device call for the whole group."""
         try:
             qs = np.stack([p.vec for p in group])
@@ -100,6 +138,20 @@ class TopNCoalescer:
                 if any(p.excluded for p in group)
                 else None
             )
+            # pad the batch to a power of two: coalesced batch sizes vary
+            # per flush, and every distinct size would otherwise be a fresh
+            # XLA trace/compile of the batched top-N program — on a
+            # tunneled backend that is seconds of compile on the hot path
+            n_real = len(group)
+            n_pad = 1 << max(0, n_real - 1).bit_length()
+            if n_pad > n_real:
+                qs = np.concatenate(
+                    [qs, np.repeat(qs[:1], n_pad - n_real, axis=0)]
+                )
+                if alloweds is not None:
+                    alloweds = alloweds + [None] * (n_pad - n_real)
+                if excluded is not None:
+                    excluded = list(excluded) + [None] * (n_pad - n_real)
             results = model.top_n_batch(qs, want, alloweds, excluded)
             for p, res in zip(group, results):
                 out = res[p.offset:p.offset + p.how_many]
@@ -108,6 +160,8 @@ class TopNCoalescer:
             log.exception("coalesced top-N batch failed")
             for p in group:
                 loop.call_soon_threadsafe(_set_exception, p.future, e)
+        finally:
+            loop.call_soon_threadsafe(self._done, loop)
 
 
 def _set_result(future: asyncio.Future, value) -> None:
