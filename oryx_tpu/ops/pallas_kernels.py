@@ -128,8 +128,16 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
     k_padded = _pad_dim(k, 8) * _pad_dim(k + 1, _LANE)
     tile_b = min(256, ((7 << 17) // max(1, k_padded)) & ~7)
     if tile_b < 8:
-        # k so large (~>450 features) that even an 8-row tile overflows the
-        # scoped-VMEM stack: fall back to XLA's cholesky rather than fail
+        # k so large (~>=300 features with this budget) that even an 8-row
+        # tile risks overflowing the scoped-VMEM stack: fall back to XLA's
+        # cholesky rather than fail to compile — and say so, because the
+        # performance difference is large
+        import logging
+
+        logging.getLogger(__name__).info(
+            "spd_solve_batched: k=%d exceeds the VMEM tile budget; using "
+            "the XLA cholesky fallback", k,
+        )
         chol = jax.scipy.linalg.cholesky(a, lower=True)
         return jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
     n_pad = _pad_dim(max(n, 1), tile_b)

@@ -121,7 +121,11 @@ class TopNCoalescer:
 
     def _done(self, loop) -> None:
         self._inflight -= 1
-        self._maybe_flush(loop)
+        if self._pending:
+            # flush NOW — whatever queued behind the finished call has
+            # already waited a full device round-trip; re-arming the window
+            # timer here would idle the device for window_ms per cycle
+            self._flush(loop)
 
     def _execute(self, loop, model, group: list[_Pending]) -> None:
         """Executor thread: ONE batched device call for the whole group."""
