@@ -40,12 +40,15 @@ Tile sizes honor the f32 (8, 128) VMEM tiling: points tiles are
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+log = logging.getLogger(__name__)
 
 TILE_N = 512
 _LANE = 128
@@ -132,9 +135,7 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
         # tile risks overflowing the scoped-VMEM stack: fall back to XLA's
         # cholesky rather than fail to compile — and say so, because the
         # performance difference is large
-        import logging
-
-        logging.getLogger(__name__).info(
+        log.info(
             "spd_solve_batched: k=%d exceeds the VMEM tile budget; using "
             "the XLA cholesky fallback", k,
         )

@@ -333,9 +333,38 @@ def test_speed_manager_folds_in(tmp_path):
     ups = mgr.build_updates([KeyMessage("k", "u1,i1,1,99999")])
     kinds = {json.loads(u)[0] for u in ups}
     assert kinds == {"X", "Y"}
+    # every update is valid JSON with the full wire shape
+    for u in ups:
+        parsed = json.loads(u)
+        assert parsed[0] in ("X", "Y") and isinstance(parsed[1], str)
+        assert all(isinstance(v, float) for v in parsed[2])
+        assert isinstance(parsed[3], list)
     # new user fold-in produces an X update for an unseen user
     ups2 = mgr.build_updates([KeyMessage("k", "brand-new-user,i1,1,99999")])
     assert any(json.loads(u)[0] == "X" and json.loads(u)[1] == "brand-new-user" for u in ups2)
+
+
+def test_update_wire_format_roundtrips_float32_exactly():
+    """The fast '%.9g' row formatter must be lossless for float32 across
+    magnitudes (it replaces json.dumps on the speed-layer hot path)."""
+    from oryx_tpu.models.als.speed import _format_rows
+
+    rng = np.random.default_rng(0)
+    v = (
+        rng.standard_normal((200, 50))
+        * (10.0 ** rng.integers(-8, 8, (200, 50)).astype(np.float64))
+    ).astype(np.float32)
+    v[0, :3] = [0.0, -0.0, 1e-38]
+    rows = _format_rows(v)
+    back = np.asarray([json.loads("[" + r + "]") for r in rows],
+                      dtype=np.float32)
+    assert np.array_equal(back, v)
+    # non-finite rows must still parse (json 'Infinity'/'NaN' fallback)
+    v[3, 0], v[4, 1] = np.inf, np.nan
+    rows = _format_rows(v)
+    back3 = json.loads("[" + rows[3] + "]")
+    back4 = json.loads("[" + rows[4] + "]")
+    assert back3[0] == float("inf") and np.isnan(back4[1])
 
 
 def test_serving_manager_end_to_end(tmp_path):
