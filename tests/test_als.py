@@ -522,6 +522,41 @@ class TestVectorizedIngest:
         batch = d.prepare([' ["ju","ji","2"]'], implicit=True, now_ms=10)
         assert batch.users.index_to_id == ["ju"]
 
+    def test_uniform_tokenizer_matches_per_line(self):
+        """The whole-corpus tokenizer must produce exactly the per-line
+        tokenizer's output wherever it claims the input (and decline
+        anything ragged/quoted/bracketed so the per-line path judges)."""
+        rng = np.random.default_rng(3)
+        for k in (2, 3, 4):
+            lines = []
+            for n in range(300):
+                f = [f"u{rng.integers(0, 9)}", f"i{rng.integers(0, 9)}",
+                     str(rng.integers(1, 5)), str(1000 + n)][:k]
+                if k >= 3 and rng.random() < 0.1:
+                    f[2] = ""  # empty strength → NaN
+                lines.append(",".join(f))
+            fast = d._tokenize_uniform(lines, "77")
+            slow = d._tokenize_per_line(lines, "77")
+            assert fast is not None and fast == slow, k
+        # ragged mixes decline to the per-line path but prepare still works
+        mixed = ["a,b", "c,d,2", "e,f,3,9"]
+        assert d._tokenize_uniform(mixed, "7") is None
+        self._check(mixed, implicit=True, now_ms=10)
+        # quotes / brackets / CR anywhere decline
+        assert d._tokenize_uniform(['a,"b",1'], "7") is None
+        assert d._tokenize_uniform(["a[0],b,1"], "7") is None
+        assert d._tokenize_uniform(["a,b,1\r"], "7") is None
+        # an id containing a comma changes the token count: declined, and
+        # the per-line path sees 4 fields (same as before this existed)
+        assert d._tokenize_uniform(["x,y", "a,b,c"], "7") is None
+        # offsetting raggedness must NOT fool the uniformity check:
+        # 4-field + 2-field among 3-field lines sums to n*k tokens
+        assert d._tokenize_uniform(["1,2,3", "4,5,6,7", "8,9"], "7") is None
+        self._check(["1,2,3", "4,5,6,7", "8,9"], implicit=True, now_ms=10)
+        # empty line / embedded newline decline
+        assert d._tokenize_uniform(["a,b", "", "c,d,e"], "7") is None
+        assert d._tokenize_uniform(["a,b,c", "p,q", "x\ny,z,w"], "7") is None
+
     def test_crlf_and_huge_timestamps(self):
         # CRLF terminators strip like the csv parser does
         fast = d._prepare_vectorized(
