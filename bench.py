@@ -41,7 +41,8 @@ BATCH = 1_024
 BASELINE_QPS = 437.0  # BASELINE.md: 50 feat / 1M items / LSH 0.3 (their best)
 HOW_MANY = 10
 LAST_TPU_PATH = os.path.join(os.path.dirname(__file__), ".bench_last_tpu.json")
-BATCH_SUBPROC_TIMEOUT = 420  # bench_batch's internal budget is 210 s + compile
+BATCH_SUBPROC_TIMEOUT = 420  # ALS loops budget 210 s + gen/pack + compiles
+EXTRAS_SUBPROC_TIMEOUT = 360  # internal deadline 280 s + final section slack
 SERVING_SUBPROC_TIMEOUT = 420
 
 # the launch environment's platform setting, BEFORE any fallback mutates it —
@@ -180,11 +181,17 @@ def _serving_bench() -> dict:
         n_lsh += len(batch)
     lsh_qps = n_lsh / (time.perf_counter() - t2)
 
+    import resource
+
     return {
         "metric": "als_recommend_throughput_1M_items_50f",
         "value": round(qps, 1),
         "unit": "recs/s",
         "vs_baseline": round(qps / BASELINE_QPS, 2),
+        # host RSS parity point — reference serving heap is 1400 MB at
+        # 50f × 2M rows (BASELINE.md §heap); Y also lives on-device here
+        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        // 1024,
         # which backend produced the number — a CPU-fallback figure
         # must never be mistaken for the TPU result
         "backend": jax.default_backend(),
@@ -397,6 +404,16 @@ def main() -> None:
     )
     if record["batch"].get("backend") == "tpu" and "error" not in record["batch"]:
         _persist_last_tpu({"batch": record["batch"]})
+
+    # the non-ALS batch-tier sections (ingest/speed/kmeans/rdf) in their
+    # own subprocess: an overrun there can never cost the ALS record
+    record["extras"] = _section_subproc(
+        [os.path.join(here, "bench_batch.py"), "--extras"],
+        EXTRAS_SUBPROC_TIMEOUT, force_cpu=not batch_on_tpu,
+        metric="batch_tier_extras",
+    )
+    if batch_on_tpu and "error" not in record["extras"]:
+        _persist_last_tpu({"extras": record["extras"]})
 
     # multi-device scaling datapoint: the mesh-sharded trainer over a
     # virtual 8-device host mesh (the multi-chip production path, minus the

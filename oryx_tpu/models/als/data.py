@@ -176,6 +176,18 @@ def build_rating_batch(
     return RatingBatch(rows[order], cols[order], vals[order], users, items)
 
 
+def _unique_inverse(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """np.unique(return_inverse) for id strings, via pandas' hash-based
+    factorize when available (~2× numpy's sort-based unique at 1M ids;
+    identical outputs with sort=True)."""
+    try:
+        import pandas as pd
+    except ImportError:  # pragma: no cover — pandas is in the base image
+        return np.unique(arr, return_inverse=True)
+    codes, cats = pd.factorize(arr, sort=True)
+    return np.asarray(cats), codes
+
+
 def _tokenize_uniform(lines: list, now_s: str):
     """Whole-corpus tokenization for the uniform plain-CSV case: ONE join,
     ONE split, and strided list slices instead of a million per-line
@@ -310,8 +322,8 @@ def _prepare_vectorized(
             IDIndexMapping(()), IDIndexMapping(()),
         )
 
-    uid_sorted, uinv = np.unique(np.asarray(users), return_inverse=True)
-    iid_sorted, iinv = np.unique(np.asarray(items), return_inverse=True)
+    uid_sorted, uinv = _unique_inverse(np.asarray(users, dtype=object))
+    iid_sorted, iinv = _unique_inverse(np.asarray(items, dtype=object))
     key = uinv.astype(np.int64) * len(iid_sorted) + iinv
 
     if implicit:
