@@ -189,9 +189,10 @@ def _serving_bench() -> dict:
         "unit": "recs/s",
         "vs_baseline": round(qps / BASELINE_QPS, 2),
         # host RSS parity point — reference serving heap is 1400 MB at
-        # 50f × 2M rows (BASELINE.md §heap); Y also lives on-device here
+        # 50f × 2M rows (BASELINE.md §heap); Y also lives on-device here.
+        # ru_maxrss is KB on Linux (this deployment); bytes on macOS
         "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        // 1024,
+        // (1024 if sys.platform != "darwin" else 1024 * 1024),
         # which backend produced the number — a CPU-fallback figure
         # must never be mistaken for the TPU result
         "backend": jax.default_backend(),
@@ -412,7 +413,7 @@ def main() -> None:
         EXTRAS_SUBPROC_TIMEOUT, force_cpu=not batch_on_tpu,
         metric="batch_tier_extras",
     )
-    if batch_on_tpu and "error" not in record["extras"]:
+    if record["extras"].get("backend") == "tpu" and "error" not in record["extras"]:
         _persist_last_tpu({"extras": record["extras"]})
 
     # multi-device scaling datapoint: the mesh-sharded trainer over a

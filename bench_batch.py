@@ -205,10 +205,15 @@ def run_extras() -> dict:
     """The non-ALS batch-tier sections (ingest, speed fold-in, k-means,
     RDF), run by bench.py as their OWN subprocess section: a hang or
     overrun here can never cost the ALS record its subprocess budget."""
+    import jax
+
     from oryx_tpu.common.executils import pin_cpu_platform_if_forced
 
     pin_cpu_platform_if_forced()  # before ANY jax touch inits a dead tunnel
-    record = {}
+    # observed backend, not launch intent: bench.py gates last-TPU
+    # persistence on this (a tunnel dying between probe and subprocess
+    # start must not record CPU numbers as on-chip evidence)
+    record = {"backend": jax.default_backend()}
     deadline = time.perf_counter() + 280.0
     for name, fn in (("ingest", run_ingest_bench), ("speed", run_speed_bench),
                      ("kmeans", run_kmeans_bench), ("rdf", run_rdf_bench)):

@@ -15,6 +15,7 @@ overlap orchestration and host↔device transfers of different candidates.
 from __future__ import annotations
 
 import logging
+import math
 import shutil
 import tempfile
 from pathlib import Path
@@ -114,7 +115,9 @@ class MLUpdate(BatchLayerUpdate):
                 log.info("unable to build any model")
                 return
             if self.threshold is not None and (
-                best_eval is None or best_eval < float(self.threshold)
+                best_eval is None
+                or math.isnan(best_eval)
+                or best_eval < float(self.threshold)
             ):
                 log.info(
                     "best model eval %s does not exceed threshold %s; not publishing",
@@ -217,9 +220,15 @@ class MLUpdate(BatchLayerUpdate):
 
 
 def _better(a, b) -> bool:
-    if a is None:
+    """Candidate-score comparison where None and NaN are worse than any real
+    score: 'real > nan' is False in IEEE terms, so a NaN-scored candidate
+    evaluated first would otherwise survive every later comparison and be
+    published as "best"."""
+    a_bad = a is None or a != a  # self-inequality: NaN of ANY float-like
+    b_bad = b is None or b != b  # (np.float32 NaN is not a python float)
+    if a_bad:
         return False
-    if b is None:
+    if b_bad:
         return True
     return a > b
 

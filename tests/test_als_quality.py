@@ -67,3 +67,46 @@ def test_als_auc_at_movielens_scale(tmp_path, compute_dtype):
     auc = update.evaluate(None, pmml, tmp_path, test, train)
     # mean AUC well above chance on structured preferences
     assert auc > 0.75, f"{compute_dtype} AUC too low: {auc}"
+
+
+def test_als_explicit_rmse_gate(tmp_path):
+    """Explicit-feedback quality: the evaluator returns −RMSE
+    (ALSUpdate.evaluate:200-247 explicit branch), and on low-rank ratings
+    with mild noise the recovered RMSE must come in well under the rating
+    scale's noise floor — BASELINE's "matching RMSE" criterion needs a
+    default-suite gate, not only the implicit AUC one."""
+    rand.use_test_seed()
+    rng = np.random.default_rng(3)
+    n_users, n_items, rank = 500, 400, 4
+    u_f = rng.standard_normal((n_users, rank)) * 0.8
+    i_f = rng.standard_normal((n_items, rank)) * 0.8
+    full = u_f @ i_f.T + 3.0  # centered on a 1..5-ish scale
+    lines = []
+    for u in range(n_users):
+        for i in rng.choice(n_items, 60, replace=False):
+            r = full[u, i] + 0.1 * rng.standard_normal()
+            lines.append(f"u{u},i{i},{r:.4f}")
+    # random timestamps: the time-ordered test split must interleave users
+    # (sequential stamps would put the tail users wholly in test, where
+    # their unseen ids drop every pair — reference join semantics)
+    for n, t in enumerate(rng.permutation(len(lines)).tolist()):
+        lines[n] += f",{t}"
+    config = cfg.overlay_on(
+        {
+            "oryx.als.implicit": False,
+            "oryx.als.iterations": 10,
+            "oryx.als.hyperparams.features": 8,
+            "oryx.als.hyperparams.lambda": 0.05,
+            "oryx.ml.eval.test-fraction": 0.1,
+        },
+        cfg.get_default(),
+    )
+    update = ALSUpdate(config)
+    data = [KeyMessage(None, ln) for ln in lines]
+    train, test = update.split_new_data_to_train_test(data)
+    pmml = update.build_model(None, train, [8, 0.05, 1.0], tmp_path)
+    assert pmml is not None
+    neg_rmse = update.evaluate(None, pmml, tmp_path, test, train)
+    rmse = -neg_rmse
+    # true signal has std ~1.6; noise floor 0.1 — require real recovery
+    assert rmse < 0.35, f"explicit RMSE too high: {rmse}"

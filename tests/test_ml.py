@@ -191,6 +191,30 @@ def test_threshold_blocks_publish(tmp_path):
         layer.close()
 
 
+def test_nan_candidate_never_beats_real_scores():
+    """IEEE 'real > nan' is False, so a NaN-scored candidate evaluated
+    first would survive every later comparison — it must lose to any real
+    score and never pass a configured threshold."""
+    from oryx_tpu.ml.mlupdate import _better
+
+    import numpy as np
+
+    nan = float("nan")
+    # numpy float32 NaN is NOT a python float — the guard must catch it too
+    assert not _better(np.float32("nan"), 0.5)
+    assert _better(0.5, np.float32("nan"))
+    assert not _better(nan, 0.5)
+    assert _better(0.5, nan)
+    assert _better(-10.0, nan)  # even a bad real score beats NaN
+    assert not _better(nan, nan)
+    assert not _better(None, nan)
+    assert _better(0.5, None)
+    # and the threshold gate treats NaN like a missing eval
+    import math
+
+    assert math.isnan(nan) and not (nan < 1000.0)  # the trap being guarded
+
+
 def test_model_ref_when_oversized(tmp_path):
     config = _ml_config(
         tmp_path, **{"oryx.update-topic.message.max-size": 10}  # force MODEL-REF
