@@ -1,0 +1,160 @@
+"""Summarize a jax.profiler trace directory: top device ops by self-time.
+
+The profiler (enabled via ``oryx.tracing.profile-dir`` or the benches'
+``ORYX_PROFILE_DIR``) writes a Chrome-trace ``*.trace.json.gz`` under
+``plugins/profile/<ts>/``. TensorBoard renders it, but a TPU pod/CI box
+rarely has one attached — this prints the part that drives optimization
+decisions (which XLA ops the step actually spends its time in) straight to
+the terminal. Reference counterpart: Oryx's Spark UI timing breakdowns
+(batch UI port, reference.conf:153) — here the equivalent visibility for
+jit'd device programs.
+
+Usage:
+    python -m oryx_tpu.tools.trace_summary <trace-dir-or-file> [--top N]
+        [--track SUBSTR]
+
+Tracks whose process/thread name matches ``--track`` (default: device-ish
+tracks — 'device', 'tpu', 'stream', the CPU PjRt client) contribute op
+rows; host python bookkeeping and XLA *compiler* threads are summarized
+only as track totals. Op rows report SELF time (nested child spans
+subtracted), so a parent pass cannot bury the ops inside it.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+_DEVICE_HINTS = ("device", "tpu", "stream", "cpuclient")
+# 'xla' is deliberately NOT a hint: it matches host-side compiler threads
+# (tf_xla-cpu-codegen and friends) whose pass timings would bury the
+# actual device op execution the tool exists to surface
+
+
+def find_trace_file(path: str) -> str:
+    """Accept a trace dir (the profiler output root) or a trace file."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "**", "*.trace.json.gz"), recursive=True
+    ))
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json.gz under {path}")
+    return hits[-1]  # newest capture
+
+
+def load_events(trace_file: str) -> tuple[list, dict]:
+    """Returns (duration events, {(pid, tid): track name})."""
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rb") as fh:
+        trace = json.loads(fh.read())
+    events = trace.get("traceEvents", [])
+    proc: dict[int, str] = {}
+    thread: dict[tuple, str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc[e.get("pid")] = e.get("args", {}).get("name", "?")
+        elif e.get("name") == "thread_name":
+            thread[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", "?")
+            )
+    tracks = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        if key not in tracks:
+            tracks[key] = (
+                f"{proc.get(key[0], '?')} / {thread.get(key, '?')}"
+            )
+    durs = [e for e in events if e.get("ph") == "X"]
+    return durs, tracks
+
+
+def summarize(path: str, top: int = 15, track_filter: "str | None" = None):
+    """Returns (track_totals, op_rows): [(track, ms)], [(op, ms, count)]."""
+    durs, tracks = load_events(find_trace_file(path))
+    track_total: dict[str, float] = defaultdict(float)
+    op_total: dict[str, float] = defaultdict(float)
+    op_count: dict[str, int] = defaultdict(int)
+
+    def is_device(track: str) -> bool:
+        low = track.lower()
+        if track_filter is not None:
+            return track_filter.lower() in low
+        return any(h in low for h in _DEVICE_HINTS)
+
+    by_track: dict[tuple, list] = defaultdict(list)
+    for e in durs:
+        key = (e.get("pid"), e.get("tid"))
+        track = tracks.get(key, "?")
+        track_total[track] += e.get("dur", 0) / 1000.0
+        if is_device(track):
+            by_track[key].append(e)
+
+    # SELF time per op: events on one thread nest (Chrome-trace 'X' spans);
+    # summing inclusive durations would double-count parents and children,
+    # so subtract each event's directly-nested children via an open-span
+    # stack over the (start-ordered, longest-first) events
+    for key, events in by_track.items():
+        events.sort(key=lambda e: (e.get("ts", 0), -e.get("dur", 0)))
+        stack: list = []  # (end_ts, name, dur, child_sum)
+        def close_until(ts):
+            while stack and stack[-1][0] <= ts:
+                end, name, dur, child = stack.pop()
+                self_ms = max(0.0, (dur - child)) / 1000.0
+                op_total[name] += self_ms
+                op_count[name] += 1
+                if stack:
+                    stack[-1][3] += dur
+        for e in events:
+            ts, dur = e.get("ts", 0), e.get("dur", 0)
+            close_until(ts)
+            stack.append([ts + dur, e.get("name", "?"), dur, 0])
+        close_until(float("inf"))
+    track_rows = sorted(track_total.items(), key=lambda t: -t[1])
+    op_rows = sorted(
+        ((n, ms, op_count[n]) for n, ms in op_total.items()),
+        key=lambda t: -t[1],
+    )[:top]
+    return track_rows, op_rows
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    top = 15
+    track_filter = None
+    try:
+        if "--top" in args:
+            i = args.index("--top")
+            top = int(args[i + 1])
+            del args[i:i + 2]
+        if "--track" in args:
+            i = args.index("--track")
+            track_filter = args[i + 1]
+            del args[i:i + 2]
+        if len(args) != 1:
+            raise ValueError("expected exactly one trace path")
+    except (IndexError, ValueError):
+        print(__doc__, file=sys.stderr)
+        return 2
+    track_rows, op_rows = summarize(args[0], top, track_filter)
+    print("tracks (total ms):")
+    for track, ms in track_rows[:10]:
+        print(f"  {ms:10.2f}  {track}")
+    print(f"\ntop {top} ops on matching tracks (self ms, count):")
+    if not op_rows:
+        print("  (none — pass --track to pick a track above)")
+    for name, ms, cnt in op_rows:
+        print(f"  {ms:10.2f}  x{cnt:<6d} {name[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,23 @@ from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.serving.console import make_console
 
 
+def test_trace_summary_finds_device_ops(tmp_path):
+    """tools/trace_summary must surface XLA ops from a real profiler
+    capture (the no-TensorBoard answer to 'what is the step doing')."""
+    import jax
+    import jax.numpy as jnp
+
+    from oryx_tpu.tools.trace_summary import summarize
+
+    with jax.profiler.trace(str(tmp_path)):
+        x = jnp.ones((256, 256))
+        (x @ x).block_until_ready()
+    track_rows, op_rows = summarize(str(tmp_path), top=30)
+    assert track_rows, "no tracks parsed"
+    names = " ".join(n for n, _, _ in op_rows)
+    assert "dot" in names or "fusion" in names, names
+
+
 def test_tracer_disabled_is_noop():
     tracer = StepTracer(cfg.get_default(), "batch")
     with tracer.step("generation", n_items=5):
