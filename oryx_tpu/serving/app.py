@@ -228,6 +228,85 @@ def _ssl_context(config) -> "ssl.SSLContext | None":
     return ctx
 
 
+class _BatchWarmer(threading.Thread):
+    """Pre-compiles the batched top-N programs when a model becomes ready.
+
+    The coalescer pads batches to powers of two for stable jit signatures;
+    on a TPU each signature's FIRST occurrence still pays an XLA compile
+    (seconds), which otherwise lands inside the first client burst after
+    every MODEL handoff. When ``oryx.serving.compute.precompile-batches``
+    is on, this thread watches for a new ready model and runs a zero-vector
+    ladder of pow2 batch sizes (largest first — the steady-state size under
+    load) through ``top_n_batch``, populating the very jit caches real
+    queries will hit. Models without a batched top-N (k-means, RDF) are
+    skipped; exclusion-carrying signatures still compile on first use."""
+
+    # the reference API's default howMany — warms the top-k width the
+    # common request hits; larger howMany values still compile on first use
+    WARM_HOW_MANY = 10
+
+    def __init__(self, manager, min_fraction: float, max_batch: int,
+                 stop_event: threading.Event):
+        super().__init__(name="OryxServingBatchWarmer", daemon=True)
+        self.manager = manager
+        self.min_fraction = min_fraction
+        # floor to a pow2 exactly like the coalescer does: warming a size
+        # real flushes never produce would waste the biggest compile
+        self.max_batch = 1 << max(0, max(1, max_batch).bit_length() - 1)
+        self._stop = stop_event
+        self.warmed_models: int = 0  # observability + tests
+
+    def run(self) -> None:
+        import time as _time
+
+        import numpy as np
+
+        last_warmed = None
+        not_before = 0.0  # fraction walks are costly: back off between tries
+        failures = 0
+        while not self._stop.wait(0.25):
+            model = self.manager.get_model()
+            if (
+                model is None
+                or model is last_warmed
+                or not hasattr(model, "top_n_batch")
+                or not hasattr(model, "features")
+            ):
+                continue
+            now = _time.monotonic()
+            if now < not_before:
+                continue
+            if model.get_fraction_loaded() < self.min_fraction:
+                # the fraction test walks the expected-ID sets (see
+                # _maybe_trigger_solvers' rate limit) — don't hammer it
+                not_before = now + 2.0
+                continue
+            ok = True
+            b = self.max_batch
+            while b >= 1:
+                if self._stop.is_set():
+                    return
+                try:
+                    model.top_n_batch(
+                        np.zeros((b, model.features), dtype=np.float32),
+                        self.WARM_HOW_MANY,
+                    )
+                except Exception:  # noqa: BLE001 — e.g. no items yet
+                    log.debug("batch warm at size %d failed", b, exc_info=True)
+                    ok = False
+                    break
+                b //= 2
+            if ok:
+                last_warmed = model
+                self.warmed_models += 1
+                failures = 0
+            else:
+                # retry the SAME model later: items may simply not have
+                # arrived yet, and a silent skip would strand the feature
+                failures += 1
+                not_before = _time.monotonic() + min(10.0, 2.0 * failures)
+
+
 class ServingLayer:
     """Lifecycle: model manager + update consumer + HTTP server
     (ServingLayer.start/await/close:121-178, ModelManagerListener:102-145)."""
@@ -245,6 +324,7 @@ class ServingLayer:
         self._update_iterator: ConsumeDataIterator | None = None
         self._consumer_thread: threading.Thread | None = None
         self._server_thread: threading.Thread | None = None
+        self._warmer: _BatchWarmer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()
         self._stopped = threading.Event()
@@ -279,6 +359,19 @@ class ServingLayer:
             target=consume, name="OryxServingLayerUpdateConsumerThread", daemon=True
         )
         self._consumer_thread.start()
+
+        if self.config.get_bool(
+            "oryx.serving.compute.precompile-batches", False
+        ):
+            self._warmer = _BatchWarmer(
+                self.manager,
+                self.config.get_float("oryx.serving.min-model-load-fraction"),
+                self.config.get_int(
+                    "oryx.serving.compute.coalesce-max-batch", 256
+                ),
+                self._stopped,
+            )
+            self._warmer.start()
 
         app = make_app(self.config, self.manager, producer)
         sslctx = _ssl_context(self.config)

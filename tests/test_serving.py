@@ -337,3 +337,51 @@ def test_tls_serving(tmp_path):
     finally:
         layer.close()
         tp.reset_memory_brokers()
+
+
+def test_precompile_batches_warms_pow2_ladder(tmp_path, monkeypatch):
+    """With precompile-batches on, a ready model's batched top-N programs
+    are exercised in the background at pow2 sizes (largest first) so a
+    MODEL handoff's first client burst pays no XLA compiles."""
+    from oryx_tpu.models.als.serving import ALSServingModel
+
+    sizes = []
+    orig = ALSServingModel.top_n_batch
+
+    def recording(self, qs, how_many, alloweds=None, excluded=None):
+        sizes.append(len(qs))
+        return orig(self, qs, how_many, alloweds, excluded)
+
+    monkeypatch.setattr(ALSServingModel, "top_n_batch", recording)
+
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.serving.compute.precompile-batches": True,
+            "oryx.serving.compute.coalesce-max-batch": 16,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    pmml, batch, known = _train_tiny(tmp_path)
+    _publish_to_topic(pmml, tmp_path, known)
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if layer._warmer is not None and layer._warmer.warmed_models:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("warmer never warmed a model")
+        assert sizes[:5] == [16, 8, 4, 2, 1], sizes
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
