@@ -55,6 +55,20 @@ class LocalitySensitiveHash:
         self.sample_rate = sample_rate
         self.features = features
         self.num_hashes, self.max_bits_differing = choose_hash_config(sample_rate)
+        # LUT row cache allocated eagerly: get_candidate_lut runs on the
+        # coalescer's executor threads concurrently, and lazy allocation
+        # would race (one thread's fresh array clobbering another's fills).
+        # Concurrent fills of the same row write identical values, and the
+        # filled flag is set only AFTER its row, so readers are safe.
+        self._popcounts: "np.ndarray | None" = None
+        if 0 < self.num_hashes and self.num_buckets <= 8192:
+            self._lut_rows = np.zeros(
+                (self.num_buckets, self.num_buckets), dtype=bool
+            )
+            self._lut_filled = np.zeros(self.num_buckets, dtype=bool)
+        else:
+            self._lut_rows = None
+            self._lut_filled = None
         rng = rand.get_random()
         if self.num_hashes:
             # near-orthogonal random hyperplanes (:80-105)
@@ -86,17 +100,49 @@ class LocalitySensitiveHash:
         weights = (1 << np.arange(self.num_hashes - 1, -1, -1)).astype(np.int32)
         return (bits.astype(np.int32) @ weights).astype(np.int32)
 
+    def _popcount_table(self) -> np.ndarray:
+        """popcount of every bucket id, built once per instance (idempotent
+        under concurrent builds: identical values)."""
+        if self._popcounts is None:
+            v = np.arange(self.num_buckets, dtype=np.int32)
+            pc = np.zeros(self.num_buckets, dtype=np.int32)
+            while v.any():
+                pc += v & 1
+                v = v >> 1
+            self._popcounts = pc
+        return self._popcounts
+
     def get_candidate_indices(self, vector: np.ndarray) -> np.ndarray:
         """All bucket ids within max_bits_differing of the query hash (:156-177)."""
-        base = self.get_index_for(vector)
         if not self.num_hashes:
             return np.asarray([0], dtype=np.int32)
+        base = self.get_index_for(vector)
+        all_ids = np.arange(self.num_buckets, dtype=np.int32)
+        pc = self._popcount_table()[all_ids ^ base]
+        return all_ids[pc <= self.max_bits_differing]
+
+    def get_candidate_lut(self, qs: np.ndarray) -> np.ndarray:
+        """(B, num_buckets) bool candidate table for a BATCH of queries.
+
+        A query's row depends only on its bucket id, so rows memoize in a
+        dense (num_buckets, num_buckets) bool table filled lazily per
+        distinct base bucket (≤ 64 MB at 8192 buckets; beyond that the
+        direct vectorized xor/popcount computation is used) — steady-state
+        builds are then one row gather instead of per-query bit loops."""
+        qs = np.atleast_2d(np.asarray(qs, dtype=np.float32))
+        if not self.num_hashes:
+            return np.ones((len(qs), 1), dtype=bool)
+        base = self.assign_buckets(qs)  # (B,)
         n = self.num_buckets
         all_ids = np.arange(n, dtype=np.int32)
-        xor = all_ids ^ base
-        popcount = np.zeros(n, dtype=np.int32)
-        v = xor.copy()
-        while v.any():
-            popcount += v & 1
-            v >>= 1
-        return all_ids[popcount <= self.max_bits_differing]
+        pc = self._popcount_table()
+        if self._lut_rows is None:  # table would exceed ~64 MB: direct
+            return pc[base[:, None] ^ all_ids[None, :]] <= self.max_bits_differing
+        missing = np.unique(base[~self._lut_filled[base]])
+        if missing.size:
+            self._lut_rows[missing] = (
+                pc[missing[:, None] ^ all_ids[None, :]]
+                <= self.max_bits_differing
+            )
+            self._lut_filled[missing] = True
+        return self._lut_rows[base]

@@ -424,11 +424,9 @@ class ALSServingModel(ServingModel):
         return out
 
     def _build_lut(self, qs_host: np.ndarray) -> np.ndarray:
-        """(B, num_buckets) bool LSH candidate lookup table, one row per query."""
-        lut = np.zeros((len(qs_host), self.lsh.num_buckets), dtype=bool)
-        for b, q in enumerate(qs_host):
-            lut[b, self.lsh.get_candidate_indices(q)] = True
-        return lut
+        """(B, num_buckets) bool LSH candidate lookup table, one row per
+        query — fully vectorized over the batch (lsh.get_candidate_lut)."""
+        return self.lsh.get_candidate_lut(qs_host)
 
     def _sharded_query(self, snap: _YSnapshot, qs_host: np.ndarray, want: int, excluded):
         """Multi-device scan: per-shard matmul + local top-k + cross-shard
