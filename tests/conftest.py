@@ -29,3 +29,15 @@ def _test_seed():
 
     rand.use_test_seed()
     yield
+
+
+class LenOnlyIDs:
+    """len()-only IDIndexMapping stand-in for trainer tests whose rows are
+    already dense indices (materializing id strings would only test the
+    host dict, not the trainer)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n

@@ -59,12 +59,7 @@ def test_trainer_spd_path_matches_cholesky_path():
     from oryx_tpu.models.als import train as tr
     from oryx_tpu.models.als.data import RatingBatch
 
-    class _IDs:
-        def __init__(self, n):
-            self.n = n
-
-        def __len__(self):
-            return self.n
+    from conftest import LenOnlyIDs as _IDs
 
     rng = np.random.default_rng(7)
     n_users, n_items, nnz, k = 300, 120, 2000, 8
