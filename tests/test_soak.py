@@ -1,0 +1,126 @@
+"""Gated multi-generation lambda soak (``ORYX_SOAK=1``).
+
+The single-generation IT (test_als_it) proves the protocol once; state
+bugs live in REPEATED model handoffs — serving snapshot invalidation,
+solver-cache refresh, old-generation GC, fold-in against a model that is
+being replaced. This runs the full three-tier loop for ~2 minutes of
+continuous input across many batch generations and asserts:
+
+  * multiple MODEL publications happen (generations actually cycle);
+  * serving stays consistent THROUGH handoffs: every /recommend-equivalent
+    query against the live model returns well-formed results;
+  * speed keeps emitting fold-in UPs in late generations (its model
+    follows the handoffs);
+  * host memory stays bounded (no per-generation leak).
+"""
+
+import json
+import os
+import resource
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.lambda_rt.speed import SpeedLayer
+from oryx_tpu.models.als.serving import ALSServingModelManager
+from oryx_tpu.transport import topic as tp
+
+_gated = pytest.mark.skipif(
+    os.environ.get("ORYX_SOAK") != "1",
+    reason="multi-minute soak; set ORYX_SOAK=1",
+)
+
+
+@_gated
+def test_multi_generation_lambda_soak(tmp_path):
+    tp.reset_memory_brokers()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "soak",
+            "oryx.batch.update-class": "oryx_tpu.models.als.update.ALSUpdate",
+            "oryx.speed.model-manager-class":
+                "oryx_tpu.models.als.speed.ALSSpeedModelManager",
+            "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+            "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+            "oryx.batch.storage.max-age-model-hours": 0.0003,  # ~1s TTL GC
+            "oryx.batch.streaming.config.platform": "cpu",
+            "oryx.speed.streaming.config.platform": "cpu",
+            "oryx.als.iterations": 2,
+            "oryx.als.hyperparams.features": 6,
+            "oryx.ml.eval.test-fraction": 0.2,
+            "oryx.ml.eval.candidates": 1,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    broker = tp.get_broker("memory:")
+    rng = np.random.default_rng(0)
+    n_users, n_items = 40, 25
+
+    batch = BatchLayer(config)
+    batch.start(interval_sec=2.0)
+    speed = SpeedLayer(config)
+    speed.start(interval_sec=0.5)
+    serving_mgr = ALSServingModelManager(config)
+    serving_it = tp.ConsumeDataIterator(broker, "OryxUpdate", "earliest")
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+
+    deadline = time.monotonic() + 120.0
+    consumed = 0
+    models_seen = 0
+    queries_ok = 0
+    rss_marks = []
+    try:
+        t = 0
+        while time.monotonic() < deadline:
+            # continuous input trickle
+            for _ in range(10):
+                u, i = rng.integers(n_users), rng.integers(n_items)
+                producer.send(None, f"u{u},i{i},1,{t}")
+                t += 1
+            # serving consumes whatever arrived
+            n = broker.size("OryxUpdate")
+            while consumed < n:
+                km = next(serving_it)
+                if km.key == "MODEL":
+                    models_seen += 1
+                serving_mgr.consume_key_message(km.key, km.message)
+                consumed += 1
+            model = serving_mgr.get_model()
+            if model is not None and model.get_fraction_loaded() >= 1.0:
+                uid = f"u{rng.integers(n_users)}"
+                uv = model.get_user_vector(uid)
+                if uv is not None:
+                    recs = model.top_n(np.asarray(uv), 3)
+                    assert len(recs) <= 3
+                    for item, score in recs:
+                        assert isinstance(item, str) and np.isfinite(score)
+                    queries_ok += 1
+            rss_marks.append(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+            )
+            time.sleep(0.25)
+
+        assert models_seen >= 3, f"only {models_seen} MODEL handoffs in soak"
+        assert queries_ok >= 50, f"only {queries_ok} live queries succeeded"
+        # speed tier still folds in during the LAST quarter of the soak:
+        # late UPs must include X updates (speed emits them, batch's
+        # publishAdditionalModelData also emits X — either proves liveness
+        # of the update stream past many handoffs)
+        msgs = broker.read("OryxUpdate", max(0, consumed - 500), 1000)
+        late_kinds = {
+            json.loads(km.message)[0] for km in msgs if km.key == "UP"
+        }
+        assert "X" in late_kinds, late_kinds
+        # bounded memory: last-quarter RSS within 300 MB of first-quarter
+        q = max(1, len(rss_marks) // 4)
+        assert rss_marks[-1] - rss_marks[q] < 300, (
+            rss_marks[q], rss_marks[-1]
+        )
+    finally:
+        batch.close()
+        speed.close()
+        tp.reset_memory_brokers()
