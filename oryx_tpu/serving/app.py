@@ -250,25 +250,31 @@ class _BatchWarmer(threading.Thread):
         super().__init__(name="OryxServingBatchWarmer", daemon=True)
         self.manager = manager
         self.min_fraction = min_fraction
-        # floor to a pow2 exactly like the coalescer does: warming a size
-        # real flushes never produce would waste the biggest compile
-        self.max_batch = 1 << max(0, max(1, max_batch).bit_length() - 1)
+        # the coalescer's own floor: warming a size real flushes never
+        # produce would waste the biggest compile
+        from oryx_tpu.serving.batcher import floor_pow2
+
+        self.max_batch = floor_pow2(max_batch)
         self._stop = stop_event
         self.warmed_models: int = 0  # observability + tests
 
     def run(self) -> None:
         import time as _time
+        import weakref
 
         import numpy as np
 
-        last_warmed = None
+        # weakref: a strong reference here would pin a RETIRED model
+        # generation (hundreds of MB of factors) for as long as its
+        # successor keeps failing to warm
+        last_warmed: "weakref.ref | None" = None
         not_before = 0.0  # fraction walks are costly: back off between tries
         failures = 0
         while not self._stop.wait(0.25):
             model = self.manager.get_model()
             if (
                 model is None
-                or model is last_warmed
+                or (last_warmed is not None and last_warmed() is model)
                 or not hasattr(model, "top_n_batch")
                 or not hasattr(model, "features")
             ):
@@ -297,7 +303,7 @@ class _BatchWarmer(threading.Thread):
                     break
                 b //= 2
             if ok:
-                last_warmed = model
+                last_warmed = weakref.ref(model)
                 self.warmed_models += 1
                 failures = 0
             else:

@@ -29,6 +29,12 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
+def floor_pow2(n: int) -> int:
+    """Largest power of two ≤ max(1, n) — the coalescer's batch-cap floor,
+    shared with the batch warmer so both always agree on real flush sizes."""
+    return 1 << max(0, max(1, n).bit_length() - 1)
+
+
 class _Pending:
     __slots__ = ("vec", "want", "how_many", "offset", "allowed", "excluded",
                  "future")
@@ -66,7 +72,7 @@ class TopNCoalescer:
         # floor to a power of two: batches pad up to a pow2 for stable jit
         # signatures, and padding must never exceed the configured cap
         # (the operator tuned it to bound device memory)
-        self.max_batch = 1 << max(0, max(1, max_batch).bit_length() - 1)
+        self.max_batch = floor_pow2(max_batch)
         self.max_inflight = max(1, max_inflight)
         self._pending: list[tuple[object, _Pending]] = []
         self._flusher: asyncio.TimerHandle | None = None
