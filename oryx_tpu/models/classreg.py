@@ -110,10 +110,14 @@ class NumericPrediction:
         self.update(example.target.value, 1)
 
     def __eq__(self, other) -> bool:
-        return (
-            isinstance(other, NumericPrediction)
-            and self.prediction == other.prediction
-        )
+        if not isinstance(other, NumericPrediction):
+            return False
+        # sequential (never nested) acquisition: no lock-order deadlock
+        with self._lock:
+            mine = self.prediction
+        with other._lock:
+            theirs = other.prediction
+        return mine == theirs
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"NumericPrediction({self.prediction}, n={self.count})"
@@ -133,12 +137,17 @@ class CategoricalPrediction:
 
     @property
     def category_probabilities(self) -> np.ndarray:
-        total = float(self.category_counts.sum())
-        return self.category_counts / total
+        # snapshot under the lock: a concurrent update() mutates counts in
+        # place, and sum + divide over a moving array skews the distribution
+        with self._lock:
+            counts = self.category_counts.copy()
+        total = float(counts.sum())
+        return counts / total
 
     @property
     def most_probable_category_encoding(self) -> int:
-        return int(np.argmax(self.category_counts))
+        with self._lock:
+            return int(np.argmax(self.category_counts))
 
     def update(self, encoding: int, count: int = 1) -> None:
         with self._lock:
@@ -149,9 +158,14 @@ class CategoricalPrediction:
         self.update(example.target.encoding, 1)
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, CategoricalPrediction) and np.array_equal(
-            self.category_counts, other.category_counts
-        )
+        if not isinstance(other, CategoricalPrediction):
+            return False
+        # sequential (never nested) acquisition: no lock-order deadlock
+        with self._lock:
+            mine = self.category_counts.copy()
+        with other._lock:
+            theirs = other.category_counts.copy()
+        return np.array_equal(mine, theirs)
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"CategoricalPrediction({self.category_counts})"

@@ -131,7 +131,8 @@ class SolverCache:
                     solver = get_solver(gramian)
                 except SingularMatrixSolverException as e:
                     log.warning("Gramian is singular (%s); keeping previous solver", e)
-                    solver = self._solver
+                    with self._lock:
+                        solver = self._solver
                 with self._lock:
                     self._solver = solver
         finally:
@@ -143,17 +144,21 @@ class SolverCache:
 
     def get(self, blocking: bool = True) -> Solver | None:
         with self._lock:
-            have = self._solver is not None
+            solver = self._solver
             dirty = self._dirty
-        if not have:
+        if solver is None:
             if not blocking:
                 self._maybe_launch(wait=False)
                 return None
             self._maybe_launch(wait=True)
-            if self._solver is None:
+            with self._lock:
+                solver = self._solver
+            if solver is None:
                 # another thread may be computing; wait for first result
                 self._first_ready.wait(timeout=60)
-            return self._solver
+                with self._lock:
+                    solver = self._solver
+            return solver
         if dirty:
             self._maybe_launch(wait=False)  # serve stale while refreshing
-        return self._solver
+        return solver
