@@ -26,15 +26,14 @@ async def distinct_word(request: web.Request) -> web.Response:
 
 
 async def add_line(request: web.Request) -> web.Response:
-    rsrc.send_input(request, request.match_info["line"])
+    await rsrc.send_input_async(request, request.match_info["line"])
     return web.Response(status=204)
 
 
 async def add_body(request: web.Request) -> web.Response:
     lines = await rsrc.read_body_lines(request)
     rsrc.check(bool(lines), "Missing input")
-    for line in lines:
-        rsrc.send_input(request, line)
+    await rsrc.send_input_many(request, lines)
     return web.Response(status=204)
 
 

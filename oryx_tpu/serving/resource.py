@@ -10,6 +10,7 @@ of the message, and render responses as JSON or CSV by Accept header.
 
 from __future__ import annotations
 
+import asyncio
 import gzip
 import hashlib
 import io
@@ -44,7 +45,12 @@ def get_serving_model(request: web.Request):
 
 def send_input(request: web.Request, message: str) -> None:
     """Write to the input topic, key = hex hash of message
-    (AbstractOryxResource.sendInput:65-69)."""
+    (AbstractOryxResource.sendInput:65-69).
+
+    Synchronous — on ``file:`` brokers the send does file I/O under the
+    broker lock, so async handlers must use :func:`send_input_async` /
+    :func:`send_input_many` instead of calling this on the event loop
+    (oryx-analyze: blocking-async)."""
     manager = get_manager(request)
     if manager.is_read_only():
         raise OryxServingException(403, "serving layer is read-only")
@@ -53,6 +59,24 @@ def send_input(request: web.Request, message: str) -> None:
         raise OryxServingException(503, "no input producer")
     key = format(int.from_bytes(hashlib.md5(message.encode()).digest()[:4], "big"), "08x")
     producer.send(key, message)
+
+
+async def send_input_async(request: web.Request, message: str) -> None:
+    """send_input off the event loop (one executor hop per message)."""
+    await asyncio.get_running_loop().run_in_executor(
+        None, send_input, request, message
+    )
+
+
+async def send_input_many(request: web.Request, messages: "list[str]") -> None:
+    """Bulk send in ONE executor hop — /ingest-sized bodies would otherwise
+    pay a loop→executor round-trip per line."""
+
+    def send_all() -> None:
+        for m in messages:
+            send_input(request, m)
+
+    await asyncio.get_running_loop().run_in_executor(None, send_all)
 
 
 def check(condition: bool, message: str, status: int = 400) -> None:

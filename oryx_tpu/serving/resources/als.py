@@ -365,7 +365,7 @@ async def set_preference(request: web.Request) -> web.Response:
             raise OryxServingException(400, f"bad strength: {body}") from e
     strength = body if body else "1"
     line = textutils.join_delimited([user, item, strength, int(time.time() * 1000)])
-    rsrc.send_input(request, line)
+    await rsrc.send_input_async(request, line)
     return web.Response(status=200)
 
 
@@ -375,7 +375,7 @@ async def delete_preference(request: web.Request) -> web.Response:
     user = request.match_info["userID"]
     item = request.match_info["itemID"]
     line = textutils.join_delimited([user, item, "", int(time.time() * 1000)])
-    rsrc.send_input(request, line)
+    await rsrc.send_input_async(request, line)
     return web.Response(status=200)
 
 
@@ -385,7 +385,7 @@ async def ingest(request: web.Request) -> web.Response:
     for line in lines:
         tokens = textutils.parse_csv(line)
         check(2 <= len(tokens) <= 4, f"bad line: {line}")
-        rsrc.send_input(request, line)
+    await rsrc.send_input_many(request, lines)
     return web.Response(status=200)
 
 

@@ -43,15 +43,14 @@ async def predict_post(request: web.Request) -> web.Response:
 
 
 async def train_datum(request: web.Request) -> web.Response:
-    rsrc.send_input(request, request.match_info["datum"])
+    await rsrc.send_input_async(request, request.match_info["datum"])
     return web.Response(status=204)
 
 
 async def train_body(request: web.Request) -> web.Response:
     lines = await rsrc.read_body_lines(request)
     check(bool(lines), "Missing input data")
-    for line in lines:
-        rsrc.send_input(request, line)
+    await rsrc.send_input_many(request, lines)
     return web.Response(status=204)
 
 

@@ -50,15 +50,14 @@ async def distance_to_nearest(request: web.Request) -> web.Response:
 
 
 async def add_datum(request: web.Request) -> web.Response:
-    rsrc.send_input(request, request.match_info["datum"])
+    await rsrc.send_input_async(request, request.match_info["datum"])
     return web.Response(status=204)
 
 
 async def add_body(request: web.Request) -> web.Response:
     lines = await rsrc.read_body_lines(request)
     check(bool(lines), "Data is needed")
-    for line in lines:
-        rsrc.send_input(request, line)
+    await rsrc.send_input_many(request, lines)
     return web.Response(status=204)
 
 
