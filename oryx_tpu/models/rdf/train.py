@@ -256,6 +256,8 @@ def forest_train(
     max_depth: int,
     max_split_candidates: int,
     impurity: str = "entropy",
+    min_node_size: int = 1,
+    min_info_gain_nats: float = 0.0,
     rng: "np.random.Generator",
 ) -> tuple[list[TrainedNode], np.ndarray]:
     """Train a forest; returns (tree roots, per-predictor importances).
@@ -276,6 +278,10 @@ def forest_train(
         impurity = "variance"
     elif impurity not in ("gini", "entropy"):
         raise ValueError(f"bad impurity: {impurity}")
+    if min_node_size < 1:
+        raise ValueError("min-node-size must be at least 1")
+    if min_info_gain_nats < 0:
+        raise ValueError("min-info-gain-nats must be non-negative")
 
     bins_np, thresholds, n_bins = bin_features(
         X, is_categorical, n_categories, max_split_candidates
@@ -320,6 +326,8 @@ def forest_train(
             max_depth=max_depth,
             task=task,
             impurity=impurity,
+            min_node_size=min_node_size,
+            min_info_gain_nats=min_info_gain_nats,
         )
         root, pred_counts = _finalize_tree(
             levels, bins_np, thresholds, is_categorical, n_categories, task
@@ -332,7 +340,8 @@ def forest_train(
 
 
 def _grow_tree(
-    bins, channels, cat_mask, rng, *, n_bins, n_features, subset_size, max_depth, task, impurity
+    bins, channels, cat_mask, rng, *, n_bins, n_features, subset_size, max_depth,
+    task, impurity, min_node_size=1, min_info_gain_nats=0.0,
 ):
     """Level-wise growth; returns per-level split decisions as host arrays."""
     n = bins.shape[0]
@@ -355,8 +364,22 @@ def _grow_tree(
             impurity=impurity,
         )
         gain = np.asarray(gain)
-        # a node splits if it found positive gain and more depth is allowed
-        split = np.isfinite(gain) & (gain > _EPS) & (depth < max_depth)
+        totals_np = np.asarray(totals)
+        cl_np, cr_np = np.asarray(cl), np.asarray(cr)
+        # a node splits if it found positive gain, more depth is allowed, and
+        # the reference's pre-prune knobs pass: per-example gain at least
+        # min-info-gain-nats, both children at least min-node-size examples
+        # (oryx.rdf.hyperparams.*, RDFUpdate.java minNodeSize/minInfoGainNats)
+        node_w = totals_np.sum(axis=1) if task == CLASSIFICATION else totals_np[:, 0]
+        norm_gain = gain / np.maximum(node_w, _EPS)
+        split = (
+            np.isfinite(gain)
+            & (gain > _EPS)
+            & (depth < max_depth)
+            & (norm_gain >= min_info_gain_nats)
+            & (cl_np >= min_node_size)
+            & (cr_np >= min_node_size)
+        )
         levels.append(
             dict(
                 split=split,

@@ -2,7 +2,8 @@
 
 Equivalent of the reference's RDFUpdate (app/oryx-app-mllib/.../rdf/
 RDFUpdate.java:91-558): num-trees from ``oryx.rdf.num-trees``; hyperparams
-max-split-candidates / max-depth / impurity from ``oryx.rdf.hyperparams.*``;
+max-split-candidates / max-depth / impurity / min-node-size /
+min-info-gain-nats from ``oryx.rdf.hyperparams.*``;
 categorical value encodings built from the distinct values in the training
 data (getDistinctValues:208-227, sorted here for determinism); training via
 the TPU histogram forest trainer (train.forest_train); per-node record counts
@@ -39,6 +40,8 @@ class RDFUpdate(MLUpdate):
             hp.from_config(config, "oryx.rdf.hyperparams.max-split-candidates"),
             hp.from_config(config, "oryx.rdf.hyperparams.max-depth"),
             hp.from_config(config, "oryx.rdf.hyperparams.impurity"),
+            hp.from_config(config, "oryx.rdf.hyperparams.min-node-size"),
+            hp.from_config(config, "oryx.rdf.hyperparams.min-info-gain-nats"),
         ]
         self.input_schema = InputSchema(config)
         if not self.input_schema.has_target():
@@ -112,6 +115,11 @@ class RDFUpdate(MLUpdate):
         max_split_candidates = int(hyper_parameters[0])
         max_depth = int(hyper_parameters[1])
         impurity = str(hyper_parameters[2])
+        # pre-prune knobs ride the hyperparam vector like the reference's
+        # (RDFUpdate.java minNodeSize/minInfoGainNats); absent entries (older
+        # 3-element callers) keep the trainer's permissive defaults
+        min_node_size = int(hyper_parameters[3]) if len(hyper_parameters) > 3 else 1
+        min_info_gain = float(hyper_parameters[4]) if len(hyper_parameters) > 4 else 0.0
         if max_split_candidates < 2:
             raise ValueError("max-split-candidates must be at least 2")
         if max_depth <= 0:
@@ -146,6 +154,8 @@ class RDFUpdate(MLUpdate):
             max_depth=max_depth,
             max_split_candidates=max_split_candidates,
             impurity=impurity,
+            min_node_size=min_node_size,
+            min_info_gain_nats=min_info_gain,
             rng=rand.get_random(),
         )
         return pmml_codec.forest_to_pmml(

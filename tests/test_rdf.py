@@ -371,7 +371,7 @@ def test_rdf_update_regression():
 def test_rdf_update_hyperparams_from_config():
     update = RDFUpdate(_cls_config())
     combos = [hp.get_trial_values(1)[0] for hp in update.get_hyper_parameter_values()]
-    assert combos == [100, 8, "entropy"]
+    assert combos == [100, 8, "entropy", 16, 0.001]
 
 
 # ---------------------------------------------------------------------------
