@@ -116,6 +116,10 @@ oryx = {
       # queue and flush on its completion (batch-while-busy), so batch size
       # tracks arrival-rate x device-latency; 2 overlaps transfer/compute.
       coalesce-inflight = 2
+      # Upper bound on a request's queue wait behind in-flight batches: a
+      # request older than this flushes even if it must exceed
+      # coalesce-inflight by one call (tail-latency cap; 0 disables).
+      coalesce-deadline-ms = 250
       # Pre-compile the pow2-batch top-N programs in the background when a
       # model becomes ready, so the first client burst after a MODEL
       # handoff does not pay XLA compiles. Off by default; turn on for

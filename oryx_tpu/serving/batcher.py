@@ -37,9 +37,10 @@ def floor_pow2(n: int) -> int:
 
 class _Pending:
     __slots__ = ("vec", "want", "how_many", "offset", "allowed", "excluded",
-                 "future")
+                 "future", "enq_t")
 
-    def __init__(self, vec, how_many, offset, allowed, excluded, future):
+    def __init__(self, vec, how_many, offset, allowed, excluded, future,
+                 enq_t: float = 0.0):
         self.vec = vec
         self.want = how_many + offset
         self.how_many = how_many
@@ -47,6 +48,7 @@ class _Pending:
         self.allowed = allowed
         self.excluded = excluded
         self.future = future
+        self.enq_t = enq_t
 
 
 class TopNCoalescer:
@@ -63,20 +65,31 @@ class TopNCoalescer:
     pipe full by overlapping one batch's host/transfer time with another's
     compute.
 
+    ``deadline_ms`` bounds the queue wait behind in-flight batches (the p99
+    failure mode: with every inflight slot busy, arrivals used to wait an
+    unbounded number of device round-trips). When the OLDEST pending request
+    has waited past the deadline, a flush dispatches anyway — exceeding
+    ``max_inflight`` by AT MOST one call, ever: while that over-cap call is
+    out, further expired waiters re-arm and wait for a completion instead of
+    stacking device calls. 0 disables.
+
     One instance per serving app; requests against different model objects
     (a MODEL handoff mid-flight) are grouped by model identity at flush."""
 
     def __init__(self, window_ms: float = 1.0, max_batch: int = 256,
-                 max_inflight: int = 2):
+                 max_inflight: int = 2, deadline_ms: float = 250.0):
         self.window_s = window_ms / 1000.0
         # floor to a power of two: batches pad up to a pow2 for stable jit
         # signatures, and padding must never exceed the configured cap
         # (the operator tuned it to bound device memory)
         self.max_batch = floor_pow2(max_batch)
         self.max_inflight = max(1, max_inflight)
+        self.deadline_s = max(0.0, deadline_ms) / 1000.0
         self._pending: list[tuple[object, _Pending]] = []
         self._flusher: asyncio.TimerHandle | None = None
+        self._deadline_timer: asyncio.TimerHandle | None = None
         self._inflight = 0
+        self.deadline_flushes = 0  # observability + tests
 
     async def top_n(self, model, query_vec, how_many: int, offset: int = 0,
                     allowed=None, excluded=None) -> list:
@@ -85,25 +98,67 @@ class TopNCoalescer:
         fut = loop.create_future()
         self._pending.append((model, _Pending(
             np.asarray(query_vec, dtype=np.float32), how_many, offset,
-            allowed, excluded, fut,
+            allowed, excluded, fut, loop.time(),
         )))
         self._maybe_flush(loop)
         return await fut
 
     def _maybe_flush(self, loop) -> None:
-        if not self._pending or self._inflight >= self.max_inflight:
-            return  # an in-flight completion will re-trigger
+        if not self._pending:
+            return
+        if self._inflight >= self.max_inflight:
+            # an in-flight completion will re-trigger; the deadline timer
+            # bounds the wait if the in-flight call is slow or wedged
+            self._arm_deadline(loop)
+            return
         if len(self._pending) >= self.max_batch:
             self._flush(loop)
         elif self._flusher is None:
             self._flusher = loop.call_later(self.window_s,
                                             lambda: self._flush(loop))
 
-    def _flush(self, loop) -> None:
+    def _arm_deadline(self, loop) -> None:
+        if self.deadline_s <= 0 or self._deadline_timer is not None:
+            return
+        oldest = self._pending[0][1].enq_t
+        # floor the re-arm delay: an ALREADY-expired waiter (over-cap slot
+        # spent, device wedged) would otherwise re-arm at 0 and busy-spin
+        # the event loop until a device call completes
+        delay = max(oldest + self.deadline_s - loop.time(),
+                    self.deadline_s / 8.0, 0.001)
+        self._deadline_timer = loop.call_later(
+            delay, lambda: self._deadline_fire(loop)
+        )
+
+    def _deadline_fire(self, loop) -> None:
+        self._deadline_timer = None
+        if not self._pending:
+            return
+        # the entry this timer was armed for may have flushed already: only
+        # force past the inflight cap for a waiter that actually expired
+        oldest = self._pending[0][1].enq_t
+        if loop.time() - oldest + 1e-4 < self.deadline_s:
+            self._arm_deadline(loop)
+            return
+        if self._inflight > self.max_inflight:
+            # the single over-cap slot is already spent (a previous forced
+            # call hasn't completed): never stack further device calls —
+            # re-arm and wait for a completion to drain the queue
+            self._arm_deadline(loop)
+            return
+        if self._inflight == self.max_inflight:
+            self.deadline_flushes += 1
+            self._flush(loop, force=True)
+        else:
+            self._flush(loop)
+        if self._pending:
+            self._arm_deadline(loop)
+
+    def _flush(self, loop, force: bool = False) -> None:
         if self._flusher is not None:
             self._flusher.cancel()
             self._flusher = None
-        if self._inflight >= self.max_inflight:
+        if not force and self._inflight >= self.max_inflight:
             return  # raced with a slower flush path; completion re-triggers
         batch = self._pending[:self.max_batch]
         self._pending = self._pending[self.max_batch:]
@@ -113,10 +168,12 @@ class TopNCoalescer:
         for model, p in batch:
             by_model.setdefault(id(model), (model, []))[1].append(p)
         # a flush spanning several model objects (MODEL handoff mid-flight)
-        # must still honor max_inflight: dispatch while slots remain and
+        # must still honor max_inflight: dispatch while slots remain (force
+        # grants exactly one over-cap slot — the deadline escape hatch) and
         # push the rest back to the queue front for the next completion
         groups = list(by_model.values())
-        while groups and self._inflight < self.max_inflight:
+        while groups and (force or self._inflight < self.max_inflight):
+            force = False
             model, group = groups.pop(0)
             self._inflight += 1
             loop.run_in_executor(None, self._execute, loop, model, group)

@@ -185,6 +185,73 @@ def test_inflight_cap_holds_across_model_groups():
     assert state["max"] == 1, state
 
 
+def test_deadline_bounds_queue_wait_behind_inflight_batches():
+    """A request enqueued behind in-flight batches must flush within the
+    configured deadline even if the in-flight call never completes (VERDICT
+    r5 #5: the 2.26 s p99 was unbounded queue wait). The coalescer may exceed
+    max_inflight by one call to honor the bound."""
+    release = threading.Event()
+
+    class _Stuck(_CountingModel):
+        def top_n_batch(self, qs, how_many, alloweds=None, excluded=None):
+            if float(qs[0][0]) == 1.0:  # the first batch wedges until released
+                release.wait(10)
+            return super().top_n_batch(qs, how_many, alloweds, excluded)
+
+    model = _Stuck()
+    coal = TopNCoalescer(window_ms=1.0, max_batch=64, max_inflight=1,
+                         deadline_ms=50.0)
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        stuck = asyncio.create_task(coal.top_n(model, np.array([1.0, 0.0]), 2))
+        await asyncio.sleep(0.02)  # let it dispatch and wedge the only slot
+        t0 = loop.time()
+        # must NOT wait for the wedged call: deadline forces a second dispatch
+        res = await coal.top_n(model, np.array([7.0, 0.0]), 2)
+        waited = loop.time() - t0
+        assert res[0][0] == "i7"
+        assert waited < 5.0, f"queue wait {waited:.3f}s not bounded by deadline"
+        assert coal.deadline_flushes >= 1
+        release.set()
+        r1 = await stuck
+        assert r1[0][0] == "i1"
+
+    asyncio.run(main())
+
+
+def test_deadline_disabled_keeps_strict_inflight_cap():
+    """deadline_ms=0 restores the strict cap: nothing dispatches while the
+    only slot is busy, so batch-while-busy semantics are unchanged."""
+    lock = threading.Lock()
+    state = {"concurrent": 0, "max": 0}
+
+    class _Slow(_CountingModel):
+        def top_n_batch(self, qs, how_many, alloweds=None, excluded=None):
+            with lock:
+                state["concurrent"] += 1
+                state["max"] = max(state["max"], state["concurrent"])
+            time.sleep(0.05)
+            try:
+                return super().top_n_batch(qs, how_many, alloweds, excluded)
+            finally:
+                with lock:
+                    state["concurrent"] -= 1
+
+    model = _Slow()
+    coal = TopNCoalescer(window_ms=1.0, max_batch=64, max_inflight=1,
+                         deadline_ms=0.0)
+
+    async def main():
+        await asyncio.gather(*[
+            coal.top_n(model, np.array([float(i), 0.0]), 2) for i in range(8)
+        ])
+
+    asyncio.run(main())
+    assert state["max"] == 1
+    assert coal.deadline_flushes == 0
+
+
 def test_device_call_failure_fails_only_that_batch():
     class _Broken(_CountingModel):
         def top_n_batch(self, *a, **kw):
