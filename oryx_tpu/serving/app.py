@@ -69,6 +69,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
             window_ms,
             config.get_int("oryx.serving.compute.coalesce-max-batch", 256),
             config.get_int("oryx.serving.compute.coalesce-inflight", 2),
+            config.get_float("oryx.serving.compute.coalesce-deadline-ms", 250.0),
         )
 
     modules = list(DEFAULT_RESOURCES)
@@ -325,7 +326,12 @@ class ServingLayer:
         self.input_broker = config.get_string("oryx.input-topic.broker")
         self.input_topic = config.get_string("oryx.input-topic.message.topic")
         self.read_only = config.get_bool("oryx.serving.api.read-only", False)
+        # TLS listens on secure-port, plaintext on port — the reference's
+        # connector split (ServingLayer.makeConnector:202-255); before this
+        # the secure-port key was declared but never read (oryx-analyze:
+        # config-key-drift)
         self.port = config.get_int("oryx.serving.api.port")
+        self.secure_port = config.get_int("oryx.serving.api.secure-port")
         self.manager: ServingModelManager | None = None
         self._update_iterator: ConsumeDataIterator | None = None
         self._consumer_thread: threading.Thread | None = None
@@ -381,6 +387,7 @@ class ServingLayer:
 
         app = make_app(self.config, self.manager, producer)
         sslctx = _ssl_context(self.config)
+        bind_port = self.secure_port if sslctx is not None else self.port
 
         def serve():
             loop = asyncio.new_event_loop()
@@ -388,9 +395,10 @@ class ServingLayer:
             asyncio.set_event_loop(loop)
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, "0.0.0.0", self.port, ssl_context=sslctx)
+            site = web.TCPSite(runner, "0.0.0.0", bind_port, ssl_context=sslctx)
             loop.run_until_complete(site.start())
-            log.info("serving layer listening on :%d", self.port)
+            log.info("serving layer listening on :%d%s", bind_port,
+                     " (TLS)" if sslctx is not None else "")
             self._started.set()
             try:
                 loop.run_forever()

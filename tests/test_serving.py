@@ -310,7 +310,8 @@ def test_tls_serving(tmp_path):
     port = ioutils.choose_free_port()
     config = cfg.overlay_on(
         {
-            "oryx.serving.api.port": port,
+            # TLS binds secure-port (ServingLayer connector split)
+            "oryx.serving.api.secure-port": port,
             "oryx.serving.api.keystore-file": str(cert),
             "oryx.serving.api.key-alias": str(key),
             "oryx.serving.model-manager-class":
