@@ -114,6 +114,13 @@ def cmd_topic_input(config, args) -> int:
 
 
 def main(argv: "list[str] | None" = None) -> int:
+    args_in = sys.argv[1:] if argv is None else list(argv)
+    if args_in and args_in[0] == "analyze":
+        # static analysis has its own option surface (--format/--baseline/...)
+        # and must not import jax; delegate before the layer parser runs
+        from oryx_tpu.tools.analyze.cli import main as analyze_main
+
+        return analyze_main(args_in[1:])
     parser = argparse.ArgumentParser(
         prog="oryx-run", description="Oryx TPU runner (oryx-run.sh equivalent)"
     )

@@ -1,0 +1,27 @@
+"""oryx-analyze: AST-based static analysis for JAX/asyncio correctness.
+
+The reference leaned on the JVM ecosystem (javac's type system, FindBugs-era
+bytecode analysis, maven enforcer rules) for whole classes of assurance that a
+dynamic TPU-native Python framework loses by default. This package rebuilds
+that layer for the failure modes this codebase actually has (VERDICT r5):
+
+  * ``jit-recompile``      — compile-churn hazards inside jitted scopes
+  * ``tracer-leak``        — host concretization of traced values
+  * ``blocking-async``     — event-loop stalls in serving handlers
+  * ``lock-discipline``    — shared state written under a lock, read without
+  * ``config-key-drift``   — oryx.* keys read but undeclared, or declared but
+                             never read
+  * ``float64-promotion``  — float64 constants flowing into jitted numerics
+
+Run it as ``python -m oryx_tpu.cli analyze [--format json|text]``; suppress a
+finding inline with ``# analyze: ignore[<checker-id>] -- justification`` or
+in the committed baseline (``conf/analyze-baseline.json``), both of which
+require a justification string.
+"""
+
+from oryx_tpu.tools.analyze.core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    analyze_project,
+    analyze_source,
+)

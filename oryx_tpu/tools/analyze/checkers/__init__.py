@@ -1,0 +1,17 @@
+"""Checker registry: one instance per checker id, in report order."""
+
+from oryx_tpu.tools.analyze.checkers.recompile import JitRecompileChecker
+from oryx_tpu.tools.analyze.checkers.tracer import TracerLeakChecker
+from oryx_tpu.tools.analyze.checkers.blocking import BlockingAsyncChecker
+from oryx_tpu.tools.analyze.checkers.locks import LockDisciplineChecker
+from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
+from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
+
+ALL_CHECKERS = (
+    JitRecompileChecker(),
+    TracerLeakChecker(),
+    BlockingAsyncChecker(),
+    LockDisciplineChecker(),
+    ConfigKeyDriftChecker(),
+    Float64PromotionChecker(),
+)

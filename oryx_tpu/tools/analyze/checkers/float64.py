@@ -1,0 +1,95 @@
+"""float64-promotion: float64 constants flowing into jitted numerics.
+
+The framework's device numerics are float32/bfloat16 by design (factors,
+scores, Gramians); the tests enable x64, so an ``np.float64`` constant or a
+dtype-less host-numpy array creation inside a jit scope silently promotes the
+whole expression to f64 there — 2x HBM, no MXU — while staying f32 in
+production. Host-side float64 (the SVD solver, PMML codecs) is deliberate
+and out of scope: only jitted scopes are checked.
+
+Flagged inside jit: references to ``np/jnp.float64``, ``dtype="float64"`` or
+``dtype=float`` (builtin float == f64), ``.astype(float64)``, and host
+``np.array/zeros/ones/full/empty`` creations with no dtype argument (numpy
+defaults them to f64). ``tracer-leak`` owns numpy-on-traced-values; this
+checker skips those to avoid double reports.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import walk_scope
+
+ID = "float64-promotion"
+
+_NP_CREATORS = {"array", "zeros", "ones", "full", "empty", "asarray", "arange"}
+
+
+class Float64PromotionChecker:
+    id = ID
+
+    def check(self, project) -> list:
+        out = []
+        for fctx in project.files:
+            for scope in fctx.jit_scopes.values():
+                out.extend(self._check_scope(fctx, scope))
+        return out
+
+    @staticmethod
+    def _is_f64_ref(fctx, node) -> bool:
+        if isinstance(node, ast.Constant):
+            return node.value == "float64"
+        if isinstance(node, ast.Name) and node.id == "float":
+            return True
+        resolved = fctx.resolve(node)
+        return resolved in ("numpy.float64", "jax.numpy.float64")
+
+    def _check_scope(self, fctx, scope) -> list:
+        out = []
+        traced = fctx.traced_names(scope)
+        for node in walk_scope(scope.node):
+            if isinstance(node, ast.keyword) and node.arg == "dtype":
+                if self._is_f64_ref(fctx, node.value):
+                    out.append(fctx.finding(
+                        ID, node.value,
+                        f"dtype=float64 inside jitted `{scope.qualname}` — "
+                        "promotes the computation off the f32/bf16 path",
+                        symbol=f"{scope.qualname}:dtype",
+                    ))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "astype":
+                if any(self._is_f64_ref(fctx, a) for a in node.args):
+                    out.append(fctx.finding(
+                        ID, node,
+                        f".astype(float64) inside jitted `{scope.qualname}` — "
+                        "doubles HBM traffic and leaves the MXU",
+                        symbol=f"{scope.qualname}:astype",
+                    ))
+                continue
+            resolved = fctx.resolve(func)
+            if resolved in ("numpy.float64", "jax.numpy.float64"):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"np.float64(...) constant inside jitted `{scope.qualname}`"
+                    " — promotes downstream arithmetic to f64",
+                    symbol=f"{scope.qualname}:float64",
+                ))
+                continue
+            if (
+                resolved
+                and resolved.split(".")[0] == "numpy"
+                and resolved.rpartition(".")[2] in _NP_CREATORS
+                and not any(kw.arg == "dtype" for kw in node.keywords)
+                and not any(fctx.is_traced(a, traced) for a in node.args)
+            ):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"host `{ast.unparse(func)}` creation without dtype inside "
+                    f"jitted `{scope.qualname}` — numpy defaults to float64 "
+                    "(pass dtype=np.float32 or use jnp)",
+                    symbol=f"{scope.qualname}:np-default-dtype",
+                ))
+        return out

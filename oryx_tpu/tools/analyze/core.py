@@ -1,0 +1,574 @@
+"""Analysis core: findings, suppressions, baselines, per-file AST context.
+
+Design: one :class:`FileContext` per source file carries everything a checker
+needs (AST, resolved import aliases, jitted-scope map with static-argument
+sets, async scopes, inline suppressions); a :class:`ProjectContext` carries
+the cross-file facts (all file contexts, the canonical config-key tree).
+Checkers are small classes over those contexts; everything is stdlib-only so
+the analyzer can run in CI without jax ever importing.
+
+Suppression surfaces (both REQUIRE a justification string, enforced by the
+``suppression-hygiene`` meta-check):
+
+  * inline:   ``# analyze: ignore[<checker-id>] -- why this is fine``
+    (on the finding's line, or alone on the line above)
+  * baseline: entries in ``conf/analyze-baseline.json`` matched by
+    (checker, path, symbol) — line-independent so unrelated edits don't
+    churn the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+# Attribute accesses on a traced value that yield STATIC (concrete-at-trace)
+# information: branching on these inside jit is fine and must not be flagged.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# Calls whose result is static regardless of argument tracedness.
+STATIC_CALLS = {"len", "isinstance", "hasattr", "type", "id", "callable"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*analyze:\s*ignore\[([a-zA-Z0-9_\-, *]+)\]\s*(?:--\s*(.*\S))?\s*$"
+)
+
+
+def walk_scope(fn_node: ast.AST):
+    """ast.walk that does NOT descend into nested function bodies — those are
+    separate scopes (and, under jit, separate jit scopes)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclasses.dataclass
+class Finding:
+    checker: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    # stable anchor for baseline matching (function/class/config key); falls
+    # back to the message so every finding is baseline-able
+    symbol: str = ""
+    suppressed_by: "str | None" = None  # None | "inline" | "baseline"
+    justification: str = ""
+
+    @property
+    def baseline_key(self) -> tuple:
+        return (self.checker, self.path, self.symbol or self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "suppressed_by": self.suppressed_by,
+            "justification": self.justification,
+        }
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.suppressed_by}]" if self.suppressed_by else ""
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}{sup}"
+
+
+class _Suppression:
+    __slots__ = ("checkers", "justification", "used")
+
+    def __init__(self, checkers: set, justification: str):
+        self.checkers = checkers
+        self.justification = justification
+        self.used = False
+
+    def matches(self, checker: str) -> bool:
+        return "*" in self.checkers or checker in self.checkers
+
+
+def _parse_suppressions(lines: list) -> dict:
+    """line number -> _Suppression. A comment-only suppression line applies
+    to the next line; a trailing comment applies to its own line."""
+    out: dict[int, _Suppression] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        sup = _Suppression(ids, (m.group(2) or "").strip())
+        target = i + 1 if raw.lstrip().startswith("#") else i
+        out[target] = sup
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Name resolution + jitted-scope detection
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> "str | None":
+    """``jax.experimental.shard_map.shard_map`` -> that string; None if the
+    expression is not a plain name/attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class JitScope:
+    """One function traced by jax (jit / shard_map / pallas_call wrapper)."""
+
+    __slots__ = ("node", "static_names", "qualname", "how")
+
+    def __init__(self, node, static_names: set, qualname: str, how: str):
+        self.node = node
+        self.static_names = static_names
+        self.qualname = qualname
+        self.how = how  # "decorator" | "call" | "nested"
+
+    def traced_params(self) -> set:
+        args = self.node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        return {
+            n for n in names if n not in self.static_names and n not in ("self", "cls")
+        }
+
+
+class FileContext:
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.suppressions = _parse_suppressions(self.lines)
+        # local alias -> dotted origin ("np" -> "numpy", "jit" -> "jax.jit")
+        self.import_map: dict[str, str] = {}
+        # bare function name -> FunctionDef nodes in this module (all scopes)
+        self.functions_by_name: dict[str, list] = {}
+        self.functions: list = []  # (qualname, node)
+        self.async_functions: list = []  # (qualname, node)
+        self.classes: list = []  # (qualname, node)
+        self._collect()
+        self.jit_scopes: dict[ast.AST, JitScope] = {}
+        self._collect_jit_scopes()
+
+    # -- imports / names ----------------------------------------------------
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_map[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    self.import_map[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        # qualnames via a scoped walk
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    self.functions.append((qual, child))
+                    self.functions_by_name.setdefault(child.name, []).append(child)
+                    if isinstance(child, ast.AsyncFunctionDef):
+                        self.async_functions.append((qual, child))
+                    walk(child, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    self.classes.append((f"{prefix}{child.name}", child))
+                    walk(child, f"{prefix}{child.name}.")
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+        self.qualname_of = {node: q for q, node in self.functions}
+
+    def resolve(self, node: ast.AST) -> "str | None":
+        """Resolve a call target to its fully-qualified origin where the
+        import map allows (``np.asarray`` -> ``numpy.asarray``)."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        origin = self.import_map.get(head, head)
+        return f"{origin}.{rest}" if rest else origin
+
+    # -- jit scopes ---------------------------------------------------------
+    def _is_jit_ref(self, node: ast.AST) -> bool:
+        r = self.resolve(node)
+        return r in ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+    def _is_tracing_transform(self, node: ast.AST) -> bool:
+        r = self.resolve(node)
+        return r in (
+            "jax.jit",
+            "jax.pjit",
+            "jax.experimental.pjit.pjit",
+            "jax.shard_map",
+            "jax.experimental.shard_map.shard_map",
+            "jax.vmap",
+            "jax.grad",
+        )
+
+    @staticmethod
+    def _static_names_from_kwargs(call: ast.Call, fn_node) -> set:
+        static: set[str] = set()
+        args = fn_node.args
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        static.add(c.value)
+            elif kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                        if 0 <= c.value < len(positional):
+                            static.add(positional[c.value])
+        return static
+
+    def _mark(self, fn_node, static: set, how: str) -> None:
+        if fn_node in self.jit_scopes:
+            self.jit_scopes[fn_node].static_names |= static
+            return
+        qual = self.qualname_of.get(fn_node, fn_node.name)
+        self.jit_scopes[fn_node] = JitScope(fn_node, static, qual, how)
+
+    def _collect_jit_scopes(self) -> None:
+        for _, fn in self.functions:
+            for dec in fn.decorator_list:
+                if self._is_jit_ref(dec):
+                    self._mark(fn, set(), "decorator")
+                elif isinstance(dec, ast.Call):
+                    if self._is_jit_ref(dec.func):
+                        self._mark(fn, self._static_names_from_kwargs(dec, fn), "decorator")
+                    elif self.resolve(dec.func) in ("functools.partial", "partial") and (
+                        dec.args and self._is_jit_ref(dec.args[0])
+                    ):
+                        self._mark(fn, self._static_names_from_kwargs(dec, fn), "decorator")
+        # functions passed by name into jit/shard_map/vmap calls
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and self._is_tracing_transform(node.func)):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Name)):
+                continue
+            for fn in self.functions_by_name.get(node.args[0].id, ()):
+                self._mark(fn, self._static_names_from_kwargs(node, fn), "call")
+        # nested defs inside a jitted scope trace with it (lax.map/scan bodies)
+        for fn in list(self.jit_scopes):
+            for inner in ast.walk(fn):
+                if inner is not fn and isinstance(inner, ast.FunctionDef):
+                    if inner not in self.jit_scopes:
+                        qual = self.qualname_of.get(inner, inner.name)
+                        self.jit_scopes[inner] = JitScope(inner, set(), qual, "nested")
+
+    # -- tracedness ---------------------------------------------------------
+    def traced_names(self, scope: JitScope, outer: "set | None" = None) -> set:
+        """Parameter-rooted traced-value propagation through simple
+        assignments. ``.shape``/``.dtype``/``len()``/``is None`` derivations
+        are static and break the chain (that is what makes branching on them
+        legal inside jit)."""
+        traced = set(scope.traced_params())
+        if outer:
+            traced |= outer
+        body_stmts = list(scope.node.body)
+        for _ in range(2):  # two passes reach chained assignments
+            changed = False
+            for stmt in body_stmts:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        continue
+                    targets = []
+                    if isinstance(node, ast.Assign):
+                        value, targets = node.value, node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        value, targets = node.value, [node.target]
+                    else:
+                        continue
+                    if value is None or not self.is_traced(value, traced):
+                        continue
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in traced:
+                                traced.add(n.id)
+                                changed = True
+            if not changed:
+                break
+        return traced
+
+    def is_traced(self, node: ast.AST, traced: set) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value, traced)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` tests pytree STRUCTURE — static
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return any(
+                self.is_traced(c, traced) for c in [node.left, *node.comparators]
+            )
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in STATIC_CALLS:
+                return False
+            parts = [*node.args, *[k.value for k in node.keywords]]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func.value)  # x.sum() is traced when x is
+            return any(self.is_traced(p, traced) for p in parts)
+        return any(self.is_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+    # -- findings -----------------------------------------------------------
+    def finding(self, checker: str, node_or_line, message: str, symbol: str = "") -> Finding:
+        line = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else getattr(node_or_line, "lineno", 1)
+        )
+        return Finding(checker, self.relpath, line, message, symbol)
+
+
+class ProjectContext:
+    def __init__(self, files: list, reference_conf_text: "str | None" = None):
+        self.files: list[FileContext] = files
+        self.by_relpath = {f.relpath: f for f in files}
+        self._reference_conf_text = reference_conf_text
+
+    def reference_conf_text(self) -> str:
+        if self._reference_conf_text is not None:
+            return self._reference_conf_text
+        from oryx_tpu.common import reference_conf
+
+        return reference_conf.REFERENCE_CONF
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict:
+    """(checker, path, symbol) -> justification. Empty when absent."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for e in data.get("entries", []):
+        out[(e["checker"], e["path"], e["symbol"])] = e.get("justification", "")
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """Skeleton baseline from current unsuppressed findings; justifications
+    start as TODO and the suppression-hygiene check fails until they are
+    written by a human."""
+    entries = [
+        {
+            "checker": f.checker,
+            "path": f.path,
+            "symbol": f.symbol or f.message,
+            "justification": "TODO: justify this accepted finding",
+        }
+        for f in findings
+        # hygiene meta-findings are generated after baseline matching and
+        # can never be suppressed by an entry — writing them would leave a
+        # dead "accepted" record while the CLI stays red
+        if f.suppressed_by is None and f.checker != "suppression-hygiene"
+    ]
+    entries.sort(key=lambda e: (e["checker"], e["path"], e["symbol"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list
+    parse_errors: list
+
+    @property
+    def unsuppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed_by is None]
+
+    @property
+    def suppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed_by is not None]
+
+    def to_dict(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.unsuppressed:
+            counts[f.checker] = counts.get(f.checker, 0) + 1
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "counts": counts,
+            "total": len(self.findings),
+            "unsuppressed": len(self.unsuppressed),
+            "suppressed": len(self.suppressed),
+            "parse_errors": self.parse_errors,
+        }
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def build_project(
+    paths: Iterable[str],
+    root: "str | None" = None,
+    reference_conf_text: "str | None" = None,
+) -> "tuple[ProjectContext, list]":
+    files, errors = [], []
+    for path in _iter_py_files(paths):
+        rel = os.path.relpath(path, root) if root else path
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            files.append(FileContext(path, rel, src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: {e}")
+    return ProjectContext(files, reference_conf_text), errors
+
+
+def _apply_suppressions(project: ProjectContext, findings: list, baseline: dict) -> list:
+    hygiene: list[Finding] = []
+    for f in findings:
+        fctx = project.by_relpath.get(f.path)
+        sup = None
+        if fctx is not None:
+            cand = fctx.suppressions.get(f.line)
+            if cand is not None and cand.matches(f.checker):
+                sup = cand
+        if sup is not None:
+            sup.used = True
+            f.suppressed_by = "inline"
+            f.justification = sup.justification
+            if not sup.justification:
+                hygiene.append(
+                    Finding(
+                        "suppression-hygiene",
+                        f.path,
+                        f.line,
+                        f"inline suppression of [{f.checker}] carries no "
+                        "justification (write `# analyze: ignore[...] -- why`)",
+                        symbol=f"{f.checker}:{f.symbol or f.message}",
+                    )
+                )
+            continue
+        just = baseline.get(f.baseline_key)
+        if just is not None:
+            f.suppressed_by = "baseline"
+            f.justification = just
+            if not just or just.startswith("TODO"):
+                hygiene.append(
+                    Finding(
+                        "suppression-hygiene",
+                        f.path,
+                        f.line,
+                        f"baseline entry for [{f.checker}] {f.symbol or f.message!r} "
+                        "has no justification",
+                        symbol=f"{f.checker}:{f.symbol or f.message}",
+                    )
+                )
+    return hygiene
+
+
+def _unused_suppressions(project: ProjectContext) -> list:
+    """A `# analyze: ignore[...]` whose finding no longer fires is stale —
+    left in place it would silently mask the next regression on that line."""
+    out = []
+    for fctx in project.files:
+        for line, sup in sorted(fctx.suppressions.items()):
+            if not sup.used:
+                ids = ",".join(sorted(sup.checkers))
+                out.append(Finding(
+                    "suppression-hygiene", fctx.relpath, line,
+                    f"stale suppression: no [{ids}] finding fires here any "
+                    "more — remove the comment so it cannot mask a future "
+                    "regression",
+                    symbol=f"stale:{ids}:{line}",
+                ))
+    return out
+
+
+def analyze_project(
+    paths: Iterable[str],
+    root: "str | None" = None,
+    baseline_path: "str | None" = None,
+    checkers: "Iterable[str] | None" = None,
+    reference_conf_text: "str | None" = None,
+) -> AnalysisResult:
+    from oryx_tpu.tools.analyze.checkers import ALL_CHECKERS
+
+    project, errors = build_project(paths, root, reference_conf_text)
+    wanted = set(checkers) if checkers else None
+    findings: list[Finding] = []
+    for checker in ALL_CHECKERS:
+        if wanted is not None and checker.id not in wanted:
+            continue
+        findings.extend(checker.check(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    findings.extend(_apply_suppressions(project, findings, baseline))
+    if wanted is None:  # partial checker runs would false-flag stale
+        findings.extend(_unused_suppressions(project))
+    return AnalysisResult(findings, errors)
+
+
+def analyze_source(
+    source: str,
+    filename: str = "fixture.py",
+    checkers: "Iterable[str] | None" = None,
+    reference_conf_text: "str | None" = None,
+    extra_sources: "dict[str, str] | None" = None,
+) -> list:
+    """Analyze in-memory source (fixture tests); returns raw findings with
+    inline suppressions applied but no baseline."""
+    from oryx_tpu.tools.analyze.checkers import ALL_CHECKERS
+
+    files = [FileContext(filename, filename, source)]
+    for rel, src in (extra_sources or {}).items():
+        files.append(FileContext(rel, rel, src))
+    project = ProjectContext(files, reference_conf_text)
+    wanted = set(checkers) if checkers else None
+    findings: list[Finding] = []
+    for checker in ALL_CHECKERS:
+        if wanted is not None and checker.id not in wanted:
+            continue
+        findings.extend(checker.check(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    findings.extend(_apply_suppressions(project, findings, {}))
+    if wanted is None:
+        findings.extend(_unused_suppressions(project))
+    return findings

@@ -1,0 +1,473 @@
+"""oryx-analyze: fixture pairs per checker (fires on a seeded violation,
+stays silent on a clean near-miss) + the tier-1 gate that holds the whole
+package at zero unsuppressed findings.
+
+The analyzer is stdlib-only (ast), so these tests never trace or compile
+anything — they parse source strings and assert on findings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import oryx_tpu
+from oryx_tpu.tools.analyze import analyze_project, analyze_source
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(oryx_tpu.__file__)))
+BASELINE = os.path.join(REPO_ROOT, "conf", "analyze-baseline.json")
+
+
+def _run(src: str, checker: str, **kw):
+    findings = analyze_source(textwrap.dedent(src), **kw)
+    return [f for f in findings if f.checker == checker]
+
+
+# ---------------------------------------------------------------------------
+# jit-recompile
+# ---------------------------------------------------------------------------
+
+
+def test_jit_recompile_fires_on_traced_branch():
+    hits = _run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if x > 0:          # traced branch: retrace per value
+                return x * 2
+            return x
+        """,
+        "jit-recompile",
+    )
+    assert len(hits) == 1 and "traced value" in hits[0].message
+
+
+def test_jit_recompile_quiet_on_static_and_shape_branches():
+    hits = _run(
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":       # static arg: legal
+                return x * 2
+            if x.shape[0] > 4:       # shape is concrete at trace time: legal
+                return x + 1
+            if x is None:            # pytree structure test: legal
+                return jnp.zeros(3)
+            return x
+        """,
+        "jit-recompile",
+    )
+    assert hits == []
+
+
+def test_jit_recompile_fires_on_jit_in_loop_and_fstring():
+    hits = _run(
+        """
+        import jax
+
+        def serve(fns, x):
+            for fn in fns:
+                g = jax.jit(fn)      # fresh wrapper per iteration
+                g(x)
+
+        @jax.jit
+        def h(x):
+            name = f"val={x}"        # concretizes the tracer
+            return x
+        """,
+        "jit-recompile",
+    )
+    assert {f.symbol for f in hits} == {"jit-in-loop", "h:fstring"}
+
+
+def test_jit_recompile_quiet_on_lru_cached_builder():
+    hits = _run(
+        """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=8)
+        def builder(k):
+            for _ in range(1):
+                pass
+            return jax.jit(lambda x: x * k)
+        """,
+        "jit-recompile",
+    )
+    assert hits == []
+
+
+def test_jit_recompile_fires_on_typoed_static_argname():
+    hits = _run(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("kk",))
+        def f(x, k):
+            return x[:k]
+        """,
+        "jit-recompile",
+    )
+    assert len(hits) == 1 and "'kk'" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_leak_fires_on_concretization_in_jit():
+    hits = _run(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            s = float(x.sum())       # concretizes
+            h = np.asarray(x)        # host numpy on a tracer
+            return s, h
+        """,
+        "tracer-leak",
+    )
+    assert len(hits) == 2
+    assert any("float()" in f.message for f in hits)
+    assert any("numpy" in f.message for f in hits)
+
+
+def test_tracer_leak_quiet_outside_jit_and_on_static():
+    hits = _run(
+        """
+        import jax
+        import numpy as np
+
+        def host(x):
+            return float(np.asarray(x).sum())   # not a jit scope
+
+        @jax.jit
+        def f(x, lo):
+            n = float(x.shape[0])    # shape is static: legal
+            return x * n
+        """,
+        "tracer-leak",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-async
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_async_fires_on_sleep_and_lock():
+    hits = _run(
+        """
+        import asyncio
+        import time
+        import threading
+
+        class H:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        async def handler(request):
+            time.sleep(0.1)
+
+        async def locked(request, h):
+            with h._lock:
+                return 1
+        """,
+        "blocking-async",
+    )
+    assert {f.symbol for f in hits} == {"handler", "locked"}
+
+
+def test_blocking_async_quiet_on_async_sleep_and_executor():
+    hits = _run(
+        """
+        import asyncio
+        import time
+
+        def slow():
+            time.sleep(0.1)
+
+        async def handler(request):
+            await asyncio.sleep(0.1)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, slow)
+        """,
+        "blocking-async",
+    )
+    assert hits == []
+
+
+def test_blocking_async_propagates_through_project_calls():
+    helper = """
+        def send_line(producer, line):
+            producer.send(None, line)
+    """
+    hits = _run(
+        """
+        from helper import send_line
+
+        async def ingest(request, producer):
+            send_line(producer, "x")
+        """,
+        "blocking-async",
+        extra_sources={"helper.py": textwrap.dedent(helper)},
+    )
+    assert len(hits) == 1 and "send_line" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_fires_on_unguarded_read():
+    hits = _run(
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+
+            def size(self):
+                return len(self.items)   # unguarded read
+        """,
+        "lock-discipline",
+    )
+    assert len(hits) == 1 and "size" in hits[0].message
+
+
+def test_lock_discipline_quiet_when_every_access_guarded():
+    hits = _run(
+        """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+
+            def size(self):
+                with self._lock:
+                    return len(self.items)
+        """,
+        "lock-discipline",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# config-key-drift
+# ---------------------------------------------------------------------------
+
+_REF = """
+oryx = {
+  serving = {
+    port = 8080
+    memory = "4000m"
+  }
+}
+"""
+
+
+def test_config_drift_fires_on_unknown_and_unread_keys():
+    hits = _run(
+        """
+        def load(config):
+            return config.get_int("oryx.serving.protx")   # typo
+        """,
+        "config-key-drift",
+        reference_conf_text=_REF,
+    )
+    symbols = {f.symbol for f in hits}
+    assert "oryx.serving.protx" in symbols          # unknown read
+    assert "oryx.serving.port" in symbols           # declared, never read
+    assert "oryx.serving.memory" in symbols
+
+
+def test_config_drift_quiet_when_keys_match():
+    hits = _run(
+        """
+        def load(config):
+            a = config.get_int("oryx.serving.port")
+            b = config.get_string("oryx.serving.memory")
+            return a, b
+        """,
+        "config-key-drift",
+        reference_conf_text=_REF,
+    )
+    assert hits == []
+
+
+def test_config_drift_resolves_fstrings_and_get_config_prefixes():
+    ref = """
+    oryx = {
+      batch = { streaming = { interval = 5 } }
+      speed = { streaming = { interval = 1 } }
+      storage = { data-dir = "/tmp/d" }
+    }
+    """
+    hits = _run(
+        """
+        def load(config, tier):
+            iv = config.get_int(f"oryx.{tier}.streaming.interval")
+            st = config.get_config("oryx.storage")
+            return iv, st.get_string("data-dir")
+        """,
+        "config-key-drift",
+        reference_conf_text=ref,
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# float64-promotion
+# ---------------------------------------------------------------------------
+
+
+def test_float64_fires_inside_jit():
+    hits = _run(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            c = np.zeros(4)                    # numpy default dtype = f64
+            d = x.astype("float64")
+            return c, d
+        """,
+        "float64-promotion",
+    )
+    assert len(hits) == 2
+
+
+def test_float64_quiet_on_f32_and_host_code():
+    hits = _run(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_solver(g):
+            return np.asarray(g, dtype=np.float64)   # deliberate host f64
+
+        @jax.jit
+        def f(x):
+            c = jnp.zeros(4)
+            d = np.zeros(4, dtype=np.float32)
+            return c + d + x
+        """,
+        "float64-promotion",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_needs_justification():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # analyze: ignore[jit-recompile]
+                return x
+            return -x
+    """
+    findings = analyze_source(textwrap.dedent(src))
+    recompile = [f for f in findings if f.checker == "jit-recompile"]
+    hygiene = [f for f in findings if f.checker == "suppression-hygiene"]
+    assert recompile and recompile[0].suppressed_by == "inline"
+    assert len(hygiene) == 1  # no justification text -> hygiene finding
+
+
+def test_inline_suppression_with_justification_is_clean():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # analyze: ignore[jit-recompile] -- retrace is intended; two variants only
+                return x
+            return -x
+    """
+    findings = analyze_source(textwrap.dedent(src))
+    assert all(f.suppressed_by == "inline" for f in findings
+               if f.checker == "jit-recompile")
+    assert not [f for f in findings if f.checker == "suppression-hygiene"]
+
+
+def test_stale_suppression_is_flagged():
+    """An ignore comment whose finding no longer fires must be reported, not
+    silently left to mask the next regression on that line."""
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2  # analyze: ignore[jit-recompile] -- fixed long ago
+            return y
+    """
+    findings = analyze_source(textwrap.dedent(src))
+    stale = [f for f in findings
+             if f.checker == "suppression-hygiene" and "stale" in f.message]
+    assert len(stale) == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real package stays clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_has_no_unsuppressed_findings():
+    """`python -m oryx_tpu.cli analyze` must exit 0 over oryx_tpu/ at HEAD:
+    new hazards either get fixed or get a justified suppression."""
+    result = analyze_project(
+        [os.path.join(REPO_ROOT, "oryx_tpu")],
+        root=REPO_ROOT,
+        baseline_path=BASELINE,
+    )
+    assert result.parse_errors == []
+    assert result.unsuppressed == [], "\n" + "\n".join(
+        f.render() for f in result.unsuppressed
+    )
+    # every suppression carries a real justification
+    for f in result.suppressed:
+        assert f.justification and not f.justification.startswith("TODO"), f.render()
+
+
+def test_cli_analyze_json_exit_zero(capsys):
+    from oryx_tpu.cli.main import main
+
+    rc = main(["analyze", "--format", "json"])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert rc == 0
+    assert data["unsuppressed"] == 0
+    assert data["suppressed"] >= 1  # the committed baseline is in use
