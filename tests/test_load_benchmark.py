@@ -58,7 +58,11 @@ def test_als_recommend_load():
         f"{qps:,.0f} qps, {ms_per_query:.3f} ms/query (batched {batch}), "
         f"rss {get_used_memory() // (1 << 20)} MiB"
     )
-    assert qps > 0
+    # regression floor ~70% of measured (VERDICT r5 #10): 479 qps at the
+    # default 200k x 50f shape on the round-6 CPU container; only enforced
+    # at the default shape so ORYX_BENCH_* sweeps stay unconstrained
+    if items == 200_000 and features == 50 and sample_rate == 1.0:
+        assert qps > 335, f"direct-path throughput regressed: {qps:.0f} qps"
 
 
 def test_als_recommend_load_smoke():
@@ -84,9 +88,10 @@ def test_als_recommend_load_smoke():
         assert len(results) == batch and len(results[0]) == how_many
         n_done += batch
     qps = n_done / (time.perf_counter() - t0)
-    # loose floor: CPU fallback easily exceeds this; a broken scan path
-    # (per-query recompiles, host fallback) does not
-    assert qps > 200, f"serving smoke throughput collapsed: {qps:.0f} qps"
+    # regression floor ~70% of measured (VERDICT r5 #10): 14.5-19.7k qps on
+    # the round-6 CPU container at this 5k x 16f shape — the old 200-qps
+    # floor let a 20x regression pass green
+    assert qps > 10_000, f"serving smoke throughput collapsed: {qps:.0f} qps"
 
 
 @_gated
@@ -110,5 +115,14 @@ def test_als_recommend_http_load():
     queries = rng.standard_normal((4096, features)).astype(np.float32)
     out = bench_mod._http_bench(model, queries, duration_s=5.0, concurrency=96)
     print(f"\n[http load] {items} items x {features}f: {out}")
-    floor = 437.0 if jax.default_backend() == "tpu" else 25.0
+    # CPU floor ~70% of the 544 qps measured at this 200k shape on the
+    # round-5 bench machine (CHANGES_r05 / VERDICT r5 #10; the old 25-qps
+    # floor was toothless). The basis is machine-dependent — the round-6
+    # container measures ~52 qps under the same 96-thread load — so weaker
+    # hosts calibrate via ORYX_BENCH_HTTP_FLOOR instead of shipping a
+    # toothless default. TPU keeps the reference's 437.
+    if jax.default_backend() == "tpu":
+        floor = 437.0
+    else:
+        floor = float(os.environ.get("ORYX_BENCH_HTTP_FLOOR", "380"))
     assert out["value"] > floor, out
