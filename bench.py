@@ -183,8 +183,14 @@ def _serving_bench() -> dict:
 
     import resource
 
+    from oryx_tpu.common import metrics as metrics_mod
+
     return {
         "metric": "als_recommend_throughput_1M_items_50f",
+        # the round's own telemetry: registry snapshot covering the whole
+        # serving section (topn/coalescer/HTTP/topic counters + histogram
+        # count/sum pairs) so perf records carry their runtime story
+        "metrics": metrics_mod.default_registry().snapshot(),
         "value": round(qps, 1),
         "unit": "recs/s",
         "vs_baseline": round(qps / BASELINE_QPS, 2),

@@ -12,6 +12,12 @@ import abc
 from typing import Iterator
 
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import metrics as metrics_mod
+
+_MODEL_GENERATIONS = metrics_mod.default_registry().counter(
+    "oryx_serving_model_generation_total",
+    "MODEL/MODEL-REF handoffs consumed by the serving model manager",
+)
 
 
 class ServingModel(abc.ABC):
@@ -61,6 +67,10 @@ class AbstractServingModelManager(ServingModelManager):
 
     def consume(self, updates: Iterator[KeyMessage]) -> None:
         for km in updates:
+            if km.key in ("MODEL", "MODEL-REF"):
+                # counted before dispatch so every app family (ALS, k-means,
+                # RDF, examples) reports generations uniformly
+                _MODEL_GENERATIONS.inc()
             self.consume_key_message(km.key, km.message)
 
     @abc.abstractmethod

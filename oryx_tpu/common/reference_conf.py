@@ -137,6 +137,22 @@ oryx = {
     process-id = null
   }
 
+  # Framework-wide metrics registry + Prometheus text exposition on
+  # GET /metrics (replaces the reference's Spark-UI/JMX metrics story;
+  # docs/observability.md has the catalog).
+  metrics = {
+    # Master kill switch for hot-path instrumentation. On by default: one
+    # event costs an enabled check + one short-lived per-family lock +
+    # a float add (~O(100ns); docs/observability.md "Overhead").
+    enabled = true
+    # GET /metrics is exempt from oryx.serving.api auth by default
+    # (scrapers rarely speak digest); true puts it behind the same auth.
+    require-auth = false
+    # Bound on distinct label sets per metric family; excess label sets
+    # are dropped and counted in oryx_metrics_dropped_label_sets_total.
+    max-label-cardinality = 512
+  }
+
   # Per-step timing + optional jax.profiler traces (replaces the reference's
   # Spark-UI observability; SURVEY §5.1).
   tracing = {

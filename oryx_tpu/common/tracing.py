@@ -21,9 +21,26 @@ import logging
 import time
 from contextlib import contextmanager
 
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common.lockutils import RateLimitCheck
 
 log = logging.getLogger(__name__)
+
+# StepTracer → registry bridge: every timed step ALSO lands in the
+# process-wide registry, labeled by (tier, step), so the /metrics view and
+# the tracer's own counters are fed from the same measured (dt, n_items)
+# at the same instant — they describe identical events by construction.
+_STEP_SECONDS = metrics_mod.default_registry().histogram(
+    "oryx_step_duration_seconds",
+    "Wall time of one generation/microbatch step by tier",
+    ("tier", "step"),
+    buckets=metrics_mod.STEP_BUCKETS,
+)
+_STEP_ITEMS = metrics_mod.default_registry().counter(
+    "oryx_step_items_total",
+    "Items processed by generation/microbatch steps by tier",
+    ("tier", "step"),
+)
 
 
 class StepTracer:
@@ -43,12 +60,20 @@ class StepTracer:
 
     @contextmanager
     def step(self, name: str, n_items: int = 0):
-        """Time one generation/microbatch; no-op-cheap when disabled."""
-        if not self.enabled:
+        """Time one generation/microbatch; no-op-cheap when disabled.
+
+        The step is ALSO recorded into the process registry
+        (``oryx_step_duration_seconds{tier,step}`` / ``oryx_step_items_total``)
+        whenever metrics are enabled — even with tracing off — from the very
+        same ``dt``/``n_items``, so ``/metrics`` and :meth:`metrics` can
+        never report different measurements for the same step."""
+        record_metrics = metrics_mod.default_registry().enabled
+        if not self.enabled and not record_metrics:
             yield
             return
         profile = (
-            self.profile_dir is not None
+            self.enabled
+            and self.profile_dir is not None
             and self.steps < self.profile_steps
         )
         if profile:
@@ -58,19 +83,25 @@ class StepTracer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.steps += 1
-            self.total_sec += dt
-            self.total_items += n_items
-            self.last_sec = dt
-            if profile and self.steps >= self.profile_steps:
-                self._stop_profiler()
-            if self._log_check.test():
-                mean = self.total_sec / max(self.steps, 1)
-                rate = self.total_items / self.total_sec if self.total_sec > 0 else 0.0
-                log.info(
-                    "[%s] %s: step %d took %.3fs (mean %.3fs, %d items, %.1f items/s cum)",
-                    self.tier, name, self.steps, dt, mean, n_items, rate,
-                )
+            if record_metrics:
+                _STEP_SECONDS.labels(self.tier, name).observe(dt)
+                if n_items:
+                    _STEP_ITEMS.labels(self.tier, name).inc(n_items)
+            if self.enabled:  # no early return: a `return` in finally would
+                # swallow an exception raised by the step body
+                self.steps += 1
+                self.total_sec += dt
+                self.total_items += n_items
+                self.last_sec = dt
+                if profile and self.steps >= self.profile_steps:
+                    self._stop_profiler()
+                if self._log_check.test():
+                    mean = self.total_sec / max(self.steps, 1)
+                    rate = self.total_items / self.total_sec if self.total_sec > 0 else 0.0
+                    log.info(
+                        "[%s] %s: step %d took %.3fs (mean %.3fs, %d items, %.1f items/s cum)",
+                        self.tier, name, self.steps, dt, mean, n_items, rate,
+                    )
 
     def _start_profiler(self) -> None:
         if self._profiling:
@@ -98,7 +129,8 @@ class StepTracer:
             self._profiling = False
 
     def metrics(self) -> dict:
-        """Counters for health/introspection endpoints."""
+        """Counters for health/introspection endpoints (fed from the same
+        measurements as the ``oryx_step_*`` registry series — see step())."""
         return {
             "steps": self.steps,
             "total_sec": round(self.total_sec, 4),

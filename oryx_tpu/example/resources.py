@@ -50,4 +50,5 @@ def register(app: web.Application) -> None:
         ("GET", "/distinct/{word}", "one word's count"),
         ("POST", "/add/{line}", "append a line of text"),
         ("POST", "/add", "append lines from the body"),
+        ("GET", "/metrics", "Prometheus metrics exposition"),
     ])

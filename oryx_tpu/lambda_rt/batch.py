@@ -19,11 +19,24 @@ from typing import Sequence
 
 from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.lambda_rt.layer import AbstractLayer
 from oryx_tpu.store.datastore import DataStore, ModelStore
 from oryx_tpu.transport.topic import TopicProducerImpl
 
 log = logging.getLogger(__name__)
+
+# step duration/items ride the StepTracer→registry bridge (oryx_step_* with
+# tier="batch"); these add what the tracer cannot see — generations run and
+# input volume handed to the user update
+_GENERATIONS = metrics_mod.default_registry().counter(
+    "oryx_batch_generations_total",
+    "Batch generations run (empty-input generations included)",
+)
+_GENERATION_ITEMS = metrics_mod.default_registry().counter(
+    "oryx_batch_generation_items_total",
+    "Input items handed to the batch update across generations",
+)
 
 
 class BatchLayer(AbstractLayer):
@@ -50,9 +63,11 @@ class BatchLayer(AbstractLayer):
         return self.load_manager_instance("oryx.batch.update-class", BatchLayerUpdate)
 
     def _on_generation(self, timestamp_ms: int, new_data: Sequence[KeyMessage]) -> None:
+        _GENERATIONS.inc()
         if not new_data:
             log.info("no new data at generation %d", timestamp_ms)
         else:
+            _GENERATION_ITEMS.inc(len(new_data))
             # 1. user update with past data + sync model producer
             past_data = list(self.data_store.read_all())
             producer = TopicProducerImpl(self.update_broker, self.update_topic)

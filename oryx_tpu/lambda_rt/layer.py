@@ -16,6 +16,7 @@ from typing import Callable, Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import classutils
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
 from oryx_tpu.transport import topic as tp
@@ -27,6 +28,7 @@ class AbstractLayer:
     def __init__(self, config, tier: str):
         self.config = config
         self.tier = tier
+        metrics_mod.configure(config)  # batch/speed never build an HTTP app
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")

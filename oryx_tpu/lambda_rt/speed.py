@@ -19,10 +19,18 @@ from typing import Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.api.speed import SpeedModelManager
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.lambda_rt.layer import AbstractLayer
 from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
 
 log = logging.getLogger(__name__)
+
+# microbatch duration/items ride the StepTracer→registry bridge (oryx_step_*
+# with tier="speed"); this counts the layer's OUTPUT — "UP" updates published
+_UPDATES_PUBLISHED = metrics_mod.default_registry().counter(
+    "oryx_speed_updates_published_total",
+    "Incremental model updates published by the speed layer",
+)
 
 
 class SpeedLayer(AbstractLayer):
@@ -60,6 +68,7 @@ class SpeedLayer(AbstractLayer):
         updates = self.model_manager.build_updates(new_data)
         for update in updates:
             self._producer.send("UP", update)
+            _UPDATES_PUBLISHED.inc()
 
     def close(self) -> None:
         if self._update_iterator is not None:

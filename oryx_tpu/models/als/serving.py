@@ -21,6 +21,8 @@ import json
 import logging
 import math
 import threading
+import time
+import weakref
 from typing import Callable, Sequence
 
 import jax
@@ -30,6 +32,7 @@ import numpy as np
 from oryx_tpu.api.serving import ServingModel
 from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
 from oryx_tpu.api.serving import AbstractServingModelManager
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.models.als import pmml_codec
 from oryx_tpu.models.als.lsh import LocalitySensitiveHash
 from oryx_tpu.models.als.rescorer import load_rescorer_providers
@@ -38,6 +41,32 @@ from oryx_tpu.common.lockutils import RateLimitCheck
 from oryx_tpu.ops.solver import SolverCache
 
 log = logging.getLogger(__name__)
+
+_TOPN_BATCH_SECONDS = metrics_mod.default_registry().histogram(
+    "oryx_serving_topn_batch_seconds",
+    "Host-observed latency of one batched top-N device call",
+)
+_TOPN_QUERIES = metrics_mod.default_registry().counter(
+    "oryx_serving_topn_queries_total",
+    "Queries answered through the batched top-N path",
+)
+_LOAD_FRACTION = metrics_mod.default_registry().gauge(
+    "oryx_serving_model_load_fraction",
+    "Fraction of expected model vectors loaded (evaluated at scrape time)",
+)
+
+
+def _load_fraction_fn(manager_ref):
+    """Scrape-time gauge callback over a WEAK manager ref: a strong ref
+    would pin a retired manager (and its factor matrices) for the process
+    lifetime after a test or redeploy drops it."""
+
+    def fn() -> float:
+        manager = manager_ref()
+        model = manager.get_model() if manager is not None else None
+        return model.get_fraction_loaded() if model is not None else 0.0
+
+    return fn
 
 
 def _round_up_pow2(n: int) -> int:
@@ -524,7 +553,22 @@ class ALSServingModel(ServingModel):
         the TPU-idiomatic serving pattern (amortizes per-call overhead that the
         reference spends thread-fanning partition scans). ``excluded[b]`` ids
         are masked device-side; ``alloweds`` host callables (rescorer SPI)
-        filter after the scan."""
+        filter after the scan. One histogram observe + one counter add per
+        CALL (not per query) keeps the hot path inside the metrics budget."""
+        _TOPN_QUERIES.inc(len(query_vecs))
+        t0 = time.perf_counter()
+        try:
+            return self._top_n_batch(query_vecs, how_many, alloweds, excluded)
+        finally:
+            _TOPN_BATCH_SECONDS.observe(time.perf_counter() - t0)
+
+    def _top_n_batch(
+        self,
+        query_vecs: np.ndarray,
+        how_many: int,
+        alloweds: "Sequence[Callable[[str], bool] | None] | None" = None,
+        excluded: "Sequence[Sequence[str] | None] | None" = None,
+    ) -> list[list[tuple[str, float]]]:
         snap = self.y_snapshot()
         if snap.mat is None or snap.n == 0:
             return [[] for _ in range(len(query_vecs))]
@@ -681,6 +725,7 @@ class ALSServingModelManager(AbstractServingModelManager):
         # reference's test-and-trigger
         self._solver_trigger_rate = RateLimitCheck(5)
         self.model: ALSServingModel | None = None
+        _LOAD_FRACTION.set_function(_load_fraction_fn(weakref.ref(self)))
         self.rescorer_provider = load_rescorer_providers(config)
         self.mesh = None
         if config.get_bool("oryx.serving.compute.sharded", False):

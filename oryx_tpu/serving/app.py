@@ -28,12 +28,128 @@ from aiohttp import web
 
 from oryx_tpu.api.serving import ServingModelManager
 from oryx_tpu.common import classutils
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
 
 log = logging.getLogger(__name__)
 
 DEFAULT_RESOURCES = ["oryx_tpu.serving.resources.common"]
+
+_REQUESTS = metrics_mod.default_registry().counter(
+    "oryx_serving_requests_total",
+    "HTTP requests by route template, method, and response status",
+    ("route", "method", "status"),
+)
+_REQUEST_LATENCY = metrics_mod.default_registry().histogram(
+    "oryx_serving_request_latency_seconds",
+    "End-to-end HTTP request latency by route template",
+    ("route",),
+)
+_IN_FLIGHT = metrics_mod.default_registry().gauge(
+    "oryx_serving_requests_in_flight",
+    "HTTP requests currently being handled",
+)
+_UPDATES_CONSUMED = metrics_mod.default_registry().counter(
+    "oryx_serving_updates_consumed_total",
+    "Update-topic messages consumed by the serving layer",
+)
+_UPDATE_LAG_MESSAGES = metrics_mod.default_registry().gauge(
+    "oryx_serving_update_lag_messages",
+    "Update-topic messages behind the broker head (consumer lag)",
+)
+_UPDATE_LAG_SECONDS = metrics_mod.default_registry().gauge(
+    "oryx_serving_update_lag_seconds",
+    "Seconds since the serving layer last consumed an update message",
+)
+
+
+def _route_template(request: web.Request) -> str:
+    """Matched route template (bounded label cardinality — never the raw
+    path, which would mint one label set per user/item id)."""
+    resource = getattr(request.match_info.route, "resource", None)
+    return getattr(resource, "canonical", None) or "unmatched"
+
+
+@web.middleware
+async def _metrics_middleware(request, handler):
+    """Outermost middleware: per-route request count/latency/status plus an
+    in-flight gauge. Counts what the client saw — auth 401s, mapped errors,
+    and 404s included."""
+    if not metrics_mod.default_registry().enabled:
+        return await handler(request)
+    _IN_FLIGHT.inc()
+    t0 = time.perf_counter()
+    status = 500
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    except asyncio.CancelledError:
+        # client disconnect/timeout cancels the handler task: no response
+        # was ever produced, so counting it as 500 would fake a 5xx spike
+        status = "cancelled"
+        raise
+    finally:
+        _IN_FLIGHT.dec()
+        route = _route_template(request)
+        _REQUEST_LATENCY.labels(route).observe(time.perf_counter() - t0)
+        _REQUESTS.labels(route, request.method, str(status)).inc()
+
+
+def _lag_seconds_fn(metered_ref):
+    """Scrape-time gauge callback over a WEAK iterator ref: a strong ref
+    (or a bound method) would pin a closed layer's iterator/broker for the
+    process lifetime and keep reporting lag for a consumer that no longer
+    exists — same pattern as the ALS load-fraction gauge."""
+
+    def fn() -> float:
+        metered = metered_ref()
+        last = metered._last_walltime if metered is not None else None
+        return 0.0 if last is None else max(0.0, time.time() - last)
+
+    return fn
+
+
+class _MeteredUpdates:
+    """Iterator bridge feeding consumer-lag metrics from the update-consumer
+    thread: messages consumed, messages behind the broker head, and (via a
+    scrape-time gauge callback) seconds since the last consumed update.
+
+    ``broker`` must be the SAME instance the iterator consumes from (for
+    ``file:`` brokers a fresh instance would rebuild a duplicate line index
+    just to answer total_size); the lag probe is skipped entirely when the
+    registry kill switch is off, since it is the one per-event cost here
+    that is broker I/O rather than arithmetic."""
+
+    def __init__(self, updates, broker, topic: str):
+        import weakref
+
+        self._updates = updates
+        self._broker = broker
+        self._topic = topic
+        self._consumed = 0
+        self._last_walltime: "float | None" = None
+        _UPDATE_LAG_SECONDS.set_function(_lag_seconds_fn(weakref.ref(self)))
+
+    def __iter__(self) -> "_MeteredUpdates":
+        return self
+
+    def __next__(self):
+        km = next(self._updates)  # blocks on the consumer thread, never the loop
+        self._consumed += 1
+        if metrics_mod.default_registry().enabled:
+            self._last_walltime = time.time()
+            _UPDATES_CONSUMED.inc()
+            try:
+                lag = self._broker.total_size(self._topic) - self._consumed
+            except Exception:  # noqa: BLE001 — lag is advisory, consuming is not
+                lag = 0
+            _UPDATE_LAG_MESSAGES.set(max(0, lag))
+        return km
 
 
 @web.middleware
@@ -52,7 +168,8 @@ async def _compression_middleware(request, handler):
 def make_app(config, manager, input_producer=None) -> web.Application:
     """Build the aiohttp application with resources from config
     (OryxApplication.java:54-96)."""
-    middlewares = [rsrc.error_middleware, _compression_middleware]
+    metrics_mod.configure(config)
+    middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
     auth_mw = _auth_middleware(config)
     if auth_mw is not None:
         middlewares.append(auth_mw)
@@ -87,7 +204,11 @@ def make_app(config, manager, input_producer=None) -> web.Application:
 
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
     if context_path not in ("", "/"):
-        outer = web.Application(middlewares=middlewares)
+        # the outer shell carries NO middlewares: aiohttp runs the outer
+        # app's chain and then the subapp's, so listing them on both made
+        # auth and compression run twice per request (and would have
+        # double-counted every metric)
+        outer = web.Application()
         outer.add_subapp(context_path, app)
         return outer
     return app
@@ -96,28 +217,51 @@ def make_app(config, manager, input_producer=None) -> web.Application:
 _AUTH_REALM = "Oryx"
 
 
+def _metrics_canonicals(config) -> frozenset:
+    """Route templates that identify the /metrics resource — the bare
+    template plus the context-path-prefixed one (subapp resources report
+    their canonical WITH the prefix). Matching on the matched template, not
+    the raw path, means a crafted path can never spoof the exemption."""
+    context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
+    return frozenset({"/metrics", context_path.rstrip("/") + "/metrics"})
+
+
+def _is_metrics_route(request: web.Request, canonicals: frozenset) -> bool:
+    resource = getattr(request.match_info.route, "resource", None)
+    return getattr(resource, "canonical", None) in canonicals
+
+
 def _auth_middleware(config):
     """Optional HTTP auth behind oryx.serving.api.{user-name,password}:
     DIGEST by default for wire parity with the reference's single-user
     InMemoryRealm (ServingLayer.java:293-321); ``auth-scheme = basic`` opts
-    into basic-over-TLS."""
+    into basic-over-TLS. GET /metrics is exempt unless
+    ``oryx.metrics.require-auth`` (Prometheus scrapers rarely speak digest)."""
     user = config.get_string("oryx.serving.api.user-name", None)
     if not user:
         return None
+    exempt = (
+        _metrics_canonicals(config)
+        if not config.get_bool("oryx.metrics.require-auth", False)
+        else frozenset()
+    )
     password = config.get_string("oryx.serving.api.password", None) or ""
     scheme = config.get_string("oryx.serving.api.auth-scheme", "digest").lower()
     if scheme == "basic":
-        return _basic_auth_middleware(user, password)
+        return _basic_auth_middleware(user, password, exempt)
     if scheme != "digest":
         raise ValueError(f"unknown oryx.serving.api.auth-scheme: {scheme}")
-    return _digest_auth_middleware(user, password)
+    return _digest_auth_middleware(user, password, exempt)
 
 
-def _basic_auth_middleware(user: str, password: str):
+def _basic_auth_middleware(user: str, password: str,
+                           exempt: frozenset = frozenset()):
     expected = base64.b64encode(f"{user}:{password}".encode()).decode()
 
     @web.middleware
     async def auth(request, handler):
+        if exempt and _is_metrics_route(request, exempt):
+            return await handler(request)
         header = request.headers.get("Authorization", "")
         if not hmac.compare_digest(header, f"Basic {expected}"):
             return web.Response(
@@ -133,7 +277,8 @@ _DIGEST_FIELD_RE = re.compile(r'(\w+)=(?:"([^"]*)"|([^\s,]+))')
 _NONCE_TTL_SEC = 300
 
 
-def _digest_auth_middleware(user: str, password: str):
+def _digest_auth_middleware(user: str, password: str,
+                            exempt: frozenset = frozenset()):
     """RFC 7616/2617 digest challenge-response (MD5 and SHA-256, qop=auth).
 
     Nonces are self-validating HMAC(timestamp) tokens — no server-side nonce
@@ -170,6 +315,8 @@ def _digest_auth_middleware(user: str, password: str):
 
     @web.middleware
     async def auth(request, handler):
+        if exempt and _is_metrics_route(request, exempt):
+            return await handler(request)
         header = request.headers.get("Authorization", "")
         if not header.startswith("Digest "):
             return challenge()
@@ -256,7 +403,10 @@ class _BatchWarmer(threading.Thread):
         from oryx_tpu.serving.batcher import floor_pow2
 
         self.max_batch = floor_pow2(max_batch)
-        self._stop = stop_event
+        # NOT named _stop: threading.Thread.join() calls an internal
+        # self._stop() when the thread finishes, and an Event attribute of
+        # that name shadows it (TypeError on the first join)
+        self._stop_event = stop_event
         self.warmed_models: int = 0  # observability + tests
 
     def run(self) -> None:
@@ -271,7 +421,7 @@ class _BatchWarmer(threading.Thread):
         last_warmed: "weakref.ref | None" = None
         not_before = 0.0  # fraction walks are costly: back off between tries
         failures = 0
-        while not self._stop.wait(0.25):
+        while not self._stop_event.wait(0.25):
             model = self.manager.get_model()
             if (
                 model is None
@@ -291,7 +441,7 @@ class _BatchWarmer(threading.Thread):
             ok = True
             b = self.max_batch
             while b >= 1:
-                if self._stop.is_set():
+                if self._stop_event.is_set():
                     return
                 try:
                     model.top_n_batch(
@@ -354,13 +504,17 @@ class ServingLayer:
         if not self.read_only:
             producer = TopicProducerImpl(self.input_broker, self.input_topic)
         self.manager = self._load_manager()
+        update_broker = get_broker(self.update_broker)
         self._update_iterator = ConsumeDataIterator(
-            get_broker(self.update_broker), self.update_topic, "earliest"
+            update_broker, self.update_topic, "earliest"
+        )
+        metered_updates = _MeteredUpdates(
+            self._update_iterator, update_broker, self.update_topic
         )
 
         def consume():
             try:
-                self.manager.consume(self._update_iterator)
+                self.manager.consume(metered_updates)
             except Exception as e:  # noqa: BLE001
                 if not self._stopped.is_set():
                     log.exception("fatal error consuming updates; closing layer")
@@ -426,6 +580,16 @@ class ServingLayer:
         self._stopped.set()
         if self._update_iterator is not None:
             self._update_iterator.close()
+        if (
+            self._warmer is not None
+            and self._warmer is not threading.current_thread()
+        ):
+            # join BEFORE closing the manager: a leaked warmer thread would
+            # keep poking get_model()/top_n_batch on a closed manager (and
+            # leak across tests); the timeout bounds a warm mid-compile
+            self._warmer.join(timeout=10)
+            if self._warmer.is_alive():
+                log.warning("batch warmer did not stop within 10s")
         if self.manager is not None:
             self.manager.close()
         if self._loop is not None:

@@ -26,7 +26,27 @@ import logging
 
 import numpy as np
 
+from oryx_tpu.common import metrics as metrics_mod
+
 log = logging.getLogger(__name__)
+
+_BATCH_SIZE = metrics_mod.default_registry().histogram(
+    "oryx_coalescer_batch_size",
+    "Real (pre-padding) request count per coalesced device call",
+    buckets=metrics_mod.POW2_BUCKETS,
+)
+_QUEUE_DEPTH = metrics_mod.default_registry().gauge(
+    "oryx_coalescer_queue_depth",
+    "Requests waiting for a coalesced flush",
+)
+_DEADLINE_FLUSHES = metrics_mod.default_registry().counter(
+    "oryx_coalescer_deadline_flushes_total",
+    "Flushes forced past the inflight cap by the queue-wait deadline",
+)
+_PAD_WASTE = metrics_mod.default_registry().counter(
+    "oryx_coalescer_pad_waste_rows_total",
+    "Padding rows added to reach power-of-two batch shapes",
+)
 
 
 def floor_pow2(n: int) -> int:
@@ -104,6 +124,7 @@ class TopNCoalescer:
         return await fut
 
     def _maybe_flush(self, loop) -> None:
+        _QUEUE_DEPTH.set(len(self._pending))
         if not self._pending:
             return
         if self._inflight >= self.max_inflight:
@@ -148,6 +169,7 @@ class TopNCoalescer:
             return
         if self._inflight == self.max_inflight:
             self.deadline_flushes += 1
+            _DEADLINE_FLUSHES.inc()
             self._flush(loop, force=True)
         else:
             self._flush(loop)
@@ -176,9 +198,11 @@ class TopNCoalescer:
             force = False
             model, group = groups.pop(0)
             self._inflight += 1
+            _BATCH_SIZE.observe(len(group))
             loop.run_in_executor(None, self._execute, loop, model, group)
         for model, group in reversed(groups):
             self._pending[:0] = [(model, p) for p in group]
+        _QUEUE_DEPTH.set(len(self._pending))
         if self._pending:
             self._maybe_flush(loop)
 
@@ -212,6 +236,7 @@ class TopNCoalescer:
             n_real = len(group)
             n_pad = 1 << max(0, n_real - 1).bit_length()
             if n_pad > n_real:
+                _PAD_WASTE.inc(n_pad - n_real)
                 qs = np.concatenate(
                     [qs, np.repeat(qs[:1], n_pad - n_real, axis=0)]
                 )

@@ -433,4 +433,5 @@ def register(app: web.Application) -> None:
         ("POST", "/pref/{userID}/{itemID}", "write a preference"),
         ("DELETE", "/pref/{userID}/{itemID}", "delete a preference"),
         ("POST", "/ingest", "bulk CSV ingest"),
+        ("GET", "/metrics", "Prometheus metrics exposition"),
     ])

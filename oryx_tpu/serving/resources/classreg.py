@@ -112,4 +112,5 @@ def register(app: web.Application) -> None:
         ("GET", "/classificationDistribution/{datum}", "per-class probabilities"),
         ("GET", "/feature/importance", "all feature importances"),
         ("GET", "/feature/importance/{n}", "one feature's importance"),
+        ("GET", "/metrics", "Prometheus metrics exposition"),
     ])

@@ -1,7 +1,10 @@
-"""Framework-level endpoints: /ready and /error.
+"""Framework-level endpoints: /ready, /error, and /metrics.
 
 Equivalent of the reference's Ready (app/oryx-app-serving/.../Ready.java:33)
-and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35).
+and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35);
+/metrics is the Prometheus exposition of the process-wide registry
+(docs/observability.md) — the stand-in for the reference's Spark-UI/JMX
+visibility (SURVEY §5.1).
 """
 
 from __future__ import annotations
@@ -9,6 +12,7 @@ from __future__ import annotations
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.serving import resource as rsrc
 
 
@@ -28,7 +32,16 @@ async def error(request: web.Request) -> web.Response:
     return web.json_response({"status": int(status), "error": message}, status=int(status))
 
 
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition of the process-wide metrics registry.
+    Exempt from API auth unless ``oryx.metrics.require-auth``."""
+    body = metrics_mod.default_registry().render().encode("utf-8")
+    return web.Response(body=body,
+                        headers={"Content-Type": metrics_mod.CONTENT_TYPE})
+
+
 def register(app: web.Application) -> None:
     app.router.add_route("GET", "/ready", ready)
     app.router.add_route("HEAD", "/ready", ready)
     app.router.add_route("GET", "/error", error)
+    app.router.add_route("GET", "/metrics", metrics)

@@ -76,4 +76,5 @@ def register(app: web.Application) -> None:
         ("GET", "/distanceToNearest/{datum}", "distance to the closest center"),
         ("POST", "/add/{datum}", "append a data point"),
         ("POST", "/add", "append data points from the body"),
+        ("GET", "/metrics", "Prometheus metrics exposition"),
     ])

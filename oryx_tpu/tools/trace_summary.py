@@ -1,23 +1,33 @@
-"""Summarize a jax.profiler trace directory: top device ops by self-time.
+"""Summarize a jax.profiler trace directory OR a /metrics registry dump.
 
-The profiler (enabled via ``oryx.tracing.profile-dir`` or the benches'
-``ORYX_PROFILE_DIR``) writes a Chrome-trace ``*.trace.json.gz`` under
-``plugins/profile/<ts>/``. TensorBoard renders it, but a TPU pod/CI box
-rarely has one attached — this prints the part that drives optimization
-decisions (which XLA ops the step actually spends its time in) straight to
-the terminal. Reference counterpart: Oryx's Spark UI timing breakdowns
-(batch UI port, reference.conf:153) — here the equivalent visibility for
-jit'd device programs.
+One tool reads both runtime-visibility sources:
+
+  * **profiler traces** — the profiler (``oryx.tracing.profile-dir`` or the
+    benches' ``ORYX_PROFILE_DIR``) writes a Chrome-trace
+    ``*.trace.json.gz``; this prints top device ops by SELF time.
+  * **live registries** — a Prometheus text dump from ``GET /metrics``
+    (docs/observability.md), given as a file or fetched straight from a
+    URL; this prints the per-step/per-histogram duration table (count,
+    total, mean, bucket-estimated p50/p95/p99) plus the top counters.
+
+Reference counterpart: Oryx's Spark UI timing breakdowns (batch UI port,
+reference.conf:153) — here the equivalent visibility for jit'd device
+programs and the serving hot path.
 
 Usage:
     python -m oryx_tpu.tools.trace_summary <trace-dir-or-file> [--top N]
         [--track SUBSTR]
+    python -m oryx_tpu.tools.trace_summary <metrics-dump-or-url> [--metrics]
 
-Tracks whose process/thread name matches ``--track`` (default: device-ish
-tracks — 'device', 'tpu', 'stream', the CPU PjRt client) contribute op
-rows; host python bookkeeping and XLA *compiler* threads are summarized
-only as track totals. Op rows report SELF time (nested child spans
-subtracted), so a parent pass cannot bury the ops inside it.
+A ``http(s)://`` argument is always fetched and read as a metrics dump
+(append ``/metrics`` yourself if you pass the bare server root); a file is
+sniffed (``# HELP``/``# TYPE``/sample lines) unless ``--metrics`` forces it.
+
+Trace mode: tracks whose process/thread name matches ``--track`` (default:
+device-ish tracks — 'device', 'tpu', 'stream', the CPU PjRt client)
+contribute op rows; host python bookkeeping and XLA *compiler* threads are
+summarized only as track totals. Op rows report SELF time (nested child
+spans subtracted), so a parent pass cannot bury the ops inside it.
 """
 
 from __future__ import annotations
@@ -26,6 +36,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -126,10 +137,158 @@ def summarize(path: str, top: int = 15, track_filter: "str | None" = None):
     return track_rows, op_rows
 
 
+# ---------------------------------------------------------------------------
+# Prometheus /metrics mode: the same per-step table from histogram buckets
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$"
+)
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def looks_like_metrics_dump(text: str) -> bool:
+    """Sniff Prometheus text exposition: HELP/TYPE headers or sample lines."""
+    for line in text.splitlines()[:50]:
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            return True
+        if line.startswith("#"):
+            continue
+        return _SAMPLE_RE.match(line) is not None
+    return False
+
+
+def parse_metrics_text(text: str) -> tuple:
+    """Returns (histograms, scalars).
+
+    ``histograms``: {base name: {label tuple: {"buckets": [(le, cumulative)],
+    "sum": float, "count": float}}} — ``le`` ascending, +Inf last.
+    ``scalars``: [(name, label tuple, value)] for counters/gauges."""
+    buckets: dict = defaultdict(dict)
+    aux: dict = defaultdict(dict)  # (base, key) -> {"sum":, "count":}
+    scalars: list = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labelblob, value_raw = m.groups()
+        labels = dict(_LABEL_RE.findall(labelblob or ""))
+        try:
+            value = float(value_raw.replace("+Inf", "inf").replace("Inf", "inf"))
+        except ValueError:
+            continue
+        if name.endswith("_bucket") and "le" in labels:
+            le_raw = labels.pop("le")
+            le = float("inf") if "Inf" in le_raw else float(le_raw)
+            key = tuple(sorted(labels.items()))
+            buckets[name[: -len("_bucket")]].setdefault(key, []).append((le, value))
+        elif name.endswith("_sum") or name.endswith("_count"):
+            base, _, kind = name.rpartition("_")
+            key = tuple(sorted(labels.items()))
+            aux[(base, key)][kind] = value
+        else:
+            scalars.append((name, tuple(sorted(labels.items())), value))
+    histograms: dict = {}
+    for base, by_key in buckets.items():
+        histograms[base] = {}
+        for key, bs in by_key.items():
+            side = aux.pop((base, key), {})
+            histograms[base][key] = {
+                "buckets": sorted(bs),
+                "sum": side.get("sum", 0.0),
+                "count": side.get("count", 0.0),
+            }
+    # _sum/_count without buckets (summaries, foreign exporters) → scalars
+    for (base, key), side in aux.items():
+        for kind, value in side.items():
+            scalars.append((f"{base}_{kind}", key, value))
+    return histograms, scalars
+
+
+def bucket_quantile(bucket_rows: list, count: float, q: float) -> float:
+    """Estimate the q-quantile from cumulative buckets with the standard
+    Prometheus linear interpolation inside the containing bucket (an upper-
+    bound-biased estimate — exactly what histogram_quantile() reports)."""
+    if count <= 0:
+        return float("nan")
+    target = q * count
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in bucket_rows:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le  # open-ended bucket: report its lower edge
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return bucket_rows[-1][0] if bucket_rows else float("nan")
+
+
+def summarize_metrics(text: str, top: int = 15) -> tuple:
+    """Returns (histogram rows, counter rows) ready for printing:
+    histogram rows are (series, count, sum, mean, p50, p95, p99)."""
+    histograms, scalars = parse_metrics_text(text)
+    hist_rows = []
+    for base in sorted(histograms):
+        for key, h in sorted(histograms[base].items()):
+            label = ",".join(f"{k}={v}" for k, v in key)
+            series = f"{base}{{{label}}}" if label else base
+            n = h["count"]
+            mean = h["sum"] / n if n else 0.0
+            hist_rows.append((
+                series, n, h["sum"], mean,
+                bucket_quantile(h["buckets"], n, 0.50),
+                bucket_quantile(h["buckets"], n, 0.95),
+                bucket_quantile(h["buckets"], n, 0.99),
+            ))
+    counter_rows = sorted(
+        (
+            (f"{n}{{{','.join(f'{k}={v}' for k, v in key)}}}" if key else n, value)
+            for n, key, value in scalars
+        ),
+        key=lambda t: -t[1],
+    )[:top]
+    return hist_rows, counter_rows
+
+
+def _print_metrics_summary(text: str, top: int) -> int:
+    hist_rows, counter_rows = summarize_metrics(text, top)
+    print("histograms (per-step durations / distributions from buckets):")
+    if not hist_rows:
+        print("  (none)")
+    hdr = f"  {'series':58s} {'count':>9s} {'total':>11s} {'mean':>9s} {'p50':>9s} {'p95':>9s} {'p99':>9s}"
+    if hist_rows:
+        print(hdr)
+    for series, n, total, mean, p50, p95, p99 in hist_rows:
+        print(f"  {series[:58]:58s} {n:9.0f} {total:11.4f} {mean:9.4f} "
+              f"{p50:9.4f} {p95:9.4f} {p99:9.4f}")
+    print(f"\ntop {top} counters/gauges:")
+    for series, value in counter_rows:
+        print(f"  {value:14.1f}  {series[:76]}")
+    return 0
+
+
+def _read_metrics_arg(path: str) -> str:
+    if path.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(path, timeout=10) as resp:  # noqa: S310 — operator-given URL
+            return resp.read().decode("utf-8", errors="replace")
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return fh.read()
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     top = 15
     track_filter = None
+    force_metrics = False
     try:
         if "--top" in args:
             i = args.index("--top")
@@ -139,11 +298,21 @@ def main(argv: "list[str] | None" = None) -> int:
             i = args.index("--track")
             track_filter = args[i + 1]
             del args[i:i + 2]
+        if "--metrics" in args:
+            force_metrics = True
+            args.remove("--metrics")
         if len(args) != 1:
             raise ValueError("expected exactly one trace path")
     except (IndexError, ValueError):
         print(__doc__, file=sys.stderr)
         return 2
+    path = args[0]
+    if path.startswith(("http://", "https://")) or force_metrics:
+        return _print_metrics_summary(_read_metrics_arg(path), top)
+    if os.path.isfile(path) and not path.endswith((".gz", ".json")):
+        text = _read_metrics_arg(path)
+        if looks_like_metrics_dump(text):
+            return _print_metrics_summary(text, top)
     track_rows, op_rows = summarize(args[0], top, track_filter)
     print("tracks (total ms):")
     for track, ms in track_rows[:10]:

@@ -46,6 +46,23 @@ from typing import Iterator
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import ioutils
+from oryx_tpu.common import metrics as metrics_mod
+
+_PRODUCED = metrics_mod.default_registry().counter(
+    "oryx_topic_produced_total",
+    "Messages produced to a topic",
+    ("topic",),
+)
+_SEND_FAILURES = metrics_mod.default_registry().counter(
+    "oryx_topic_send_failures_total",
+    "Producer sends that raised (oversize or broker append failure)",
+    ("topic",),
+)
+_CONSUMED = metrics_mod.default_registry().counter(
+    "oryx_topic_consumed_total",
+    "Messages handed to consumers from a topic",
+    ("topic",),
+)
 
 
 class TopicException(Exception):
@@ -526,11 +543,16 @@ class TopicProducerImpl:
     def send(self, key, message) -> None:
         if self._broker is None:
             self._broker = get_broker(self._broker_url)
-        if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
-            raise TopicException(
-                f"message of {len(message)} bytes exceeds max {self._max_size}"
-            )
-        self._broker.append(self._topic, key, message)
+        try:
+            if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
+                raise TopicException(
+                    f"message of {len(message)} bytes exceeds max {self._max_size}"
+                )
+            self._broker.append(self._topic, key, message)
+        except Exception:
+            _SEND_FAILURES.labels(self._topic).inc()
+            raise
+        _PRODUCED.labels(self._topic).inc()
 
     def close(self) -> None:
         self._broker = None
@@ -654,6 +676,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 stop=self._closed,
             )
             backoff = min(backoff * 2, self._MAX_BACKOFF)
+        _CONSUMED.labels(self._topic).inc()
         return self._buffer.pop(0)
 
     def close(self) -> None:

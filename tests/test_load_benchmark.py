@@ -68,8 +68,20 @@ def test_als_recommend_load():
 def test_als_recommend_load_smoke():
     """Always-on small-shape load smoke (VERDICT r4 #6): the batched top-N
     serving path must sustain a sane request rate even on the CPU test
-    backend — catches gross throughput regressions in the default suite."""
+    backend — catches gross throughput regressions in the default suite.
+
+    The floor is enforced WITH metrics instrumentation enabled (the
+    default): the hot path pays one histogram observe + one counter add per
+    device call, and this test pins that overhead budget — if
+    instrumentation ever gets expensive enough to drop the smoke below
+    10k qps, this fails before production notices."""
+    from oryx_tpu.common import metrics as metrics_mod
     from oryx_tpu.models.als.serving import ALSServingModel
+
+    registry = metrics_mod.default_registry()
+    assert registry.enabled, "metrics must be ON while the floor is measured"
+    topn_before = registry.snapshot().get(
+        "oryx_serving_topn_batch_seconds_count", {}).get("", 0)
 
     rng = np.random.default_rng(0)
     items, features, how_many, batch = 5_000, 16, 5, 128
@@ -88,6 +100,10 @@ def test_als_recommend_load_smoke():
         assert len(results) == batch and len(results[0]) == how_many
         n_done += batch
     qps = n_done / (time.perf_counter() - t0)
+    # the instrumented path really ran instrumented (one observe per call)
+    topn_after = registry.snapshot().get(
+        "oryx_serving_topn_batch_seconds_count", {}).get("", 0)
+    assert topn_after - topn_before >= 1 + n_done // batch
     # regression floor ~70% of measured (VERDICT r5 #10): 14.5-19.7k qps on
     # the round-6 CPU container at this 5k x 16f shape — the old 200-qps
     # floor let a 20x regression pass green

@@ -1,0 +1,567 @@
+"""Metrics registry + /metrics exposition tests.
+
+Covers: registry semantics (concurrent increments, histogram bucket edges,
+label-cardinality cap), the exposition-format golden output, the serving
+middleware (status/latency for 200/404/error routes), the coalescer
+batch-size histogram, /metrics auth exemption (default + opt-in +
+context-path), the StepTracer→registry bridge, topic counters, and the
+end-to-end acceptance run over the real aiohttp serving layer (traffic +
+one MODEL handoff → latency histogram, batch-size histogram, generation
+counter, update-lag gauges all present in one scrape).
+"""
+
+import asyncio
+import json
+import re
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp import web
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils
+from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common.metrics import MetricsRegistry
+from oryx_tpu.common.tracing import StepTracer
+from oryx_tpu.serving.app import ServingLayer, make_app
+from oryx_tpu.transport import topic as tp
+
+
+def _get(snap: dict, name: str, label: str = "", default=0):
+    return snap.get(name, {}).get(label, default)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_concurrent_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("oryx_t_total", "t", ("k",))
+
+    def work():
+        child = c.labels("v")
+        for _ in range(10_000):
+            child.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels("v").value == 80_000
+
+
+def test_histogram_bucket_edges_are_inclusive():
+    reg = MetricsRegistry()
+    h = reg.histogram("oryx_h", "h", buckets=(1.0, 2.0, 4.0))
+    for v in (1.0, 1.5, 4.0, 4.1):
+        h.observe(v)
+    text = reg.render()
+    # le is an INCLUSIVE upper bound: 1.0 lands in le="1", 4.0 in le="4"
+    assert 'oryx_h_bucket{le="1"} 1' in text
+    assert 'oryx_h_bucket{le="2"} 2' in text
+    assert 'oryx_h_bucket{le="4"} 3' in text
+    assert 'oryx_h_bucket{le="+Inf"} 4' in text
+    assert "oryx_h_count 4" in text
+    assert "oryx_h_sum 10.6" in text
+
+
+def test_label_cardinality_cap_drops_and_counts():
+    reg = MetricsRegistry(max_label_cardinality=4)
+    c = reg.counter("oryx_many_total", "m", ("k",))
+    for i in range(10):
+        c.labels(f"k{i}").inc()
+    snap = reg.snapshot()
+    kept = [k for k in snap["oryx_many_total"] if k]
+    assert len(kept) == 4
+    assert _get(snap, "oryx_metrics_dropped_label_sets_total") == 6
+    # dropped label sets still accept updates (no-op) without raising
+    c.labels("k9").inc(100)
+    assert _get(reg.snapshot(), "oryx_metrics_dropped_label_sets_total") == 7
+
+
+def test_conflicting_reregistration_raises_and_identical_is_idempotent():
+    reg = MetricsRegistry()
+    a = reg.counter("oryx_once_total", "x", ("k",))
+    assert reg.counter("oryx_once_total", "x", ("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("oryx_once_total", "x", ("k",))
+    with pytest.raises(ValueError):
+        reg.counter("oryx_once_total", "x", ("other",))
+
+
+def test_gauge_function_evaluated_at_scrape_and_errors_render_nan():
+    reg = MetricsRegistry()
+    g = reg.gauge("oryx_g", "g")
+    box = {"v": 1.0}
+    g.set_function(lambda: box["v"])
+    assert "oryx_g 1" in reg.render()
+    box["v"] = 2.5
+    assert "oryx_g 2.5" in reg.render()
+
+    def boom():
+        raise RuntimeError("scrape must survive")
+
+    g.set_function(boom)
+    assert "oryx_g NaN" in reg.render()
+
+
+def test_disabled_registry_is_a_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("oryx_c_total", "c")
+    h = reg.histogram("oryx_h2", "h", buckets=(1.0,))
+    g = reg.gauge("oryx_g2", "g")
+    c.inc()
+    h.observe(0.5)
+    g.set(9)
+    snap = reg.snapshot()
+    assert _get(snap, "oryx_c_total") == 0
+    assert _get(snap, "oryx_h2_count") == 0
+    assert _get(snap, "oryx_g2") == 0
+
+
+def test_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("oryx_req_total", "Requests handled", ("route", "status"))
+    c.labels("/r", "200").inc(3)
+    c.labels('/q"x"\n', "500").inc()  # label escaping
+    g = reg.gauge("oryx_inflight", "In flight")
+    g.set(2)
+    h = reg.histogram("oryx_lat_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    assert reg.render() == (
+        "# HELP oryx_inflight In flight\n"
+        "# TYPE oryx_inflight gauge\n"
+        "oryx_inflight 2\n"
+        "# HELP oryx_lat_seconds Latency\n"
+        "# TYPE oryx_lat_seconds histogram\n"
+        'oryx_lat_seconds_bucket{le="0.1"} 1\n'
+        'oryx_lat_seconds_bucket{le="1"} 2\n'
+        'oryx_lat_seconds_bucket{le="+Inf"} 2\n'
+        "oryx_lat_seconds_sum 0.55\n"
+        "oryx_lat_seconds_count 2\n"
+        "# HELP oryx_metrics_dropped_label_sets_total "
+        "Label sets dropped by the per-family cardinality cap\n"
+        "# TYPE oryx_metrics_dropped_label_sets_total counter\n"
+        "oryx_metrics_dropped_label_sets_total 0\n"
+        "# HELP oryx_req_total Requests handled\n"
+        "# TYPE oryx_req_total counter\n"
+        'oryx_req_total{route="/q\\"x\\"\\n",status="500"} 1\n'
+        'oryx_req_total{route="/r",status="200"} 3\n'
+    )
+
+
+# ---------------------------------------------------------------------------
+# StepTracer → registry bridge
+# ---------------------------------------------------------------------------
+
+
+def test_step_tracer_feeds_registry_even_with_tracing_off():
+    reg = metrics_mod.default_registry()
+    key = 'tier="batch",step="generation"'
+    before = reg.snapshot()
+    tracer = StepTracer(cfg.get_default(), "batch")  # tracing disabled
+    with tracer.step("generation", n_items=3):
+        pass
+    after = reg.snapshot()
+    assert (
+        _get(after, "oryx_step_duration_seconds_count", key)
+        == _get(before, "oryx_step_duration_seconds_count", key) + 1
+    )
+    assert (
+        _get(after, "oryx_step_items_total", key)
+        == _get(before, "oryx_step_items_total", key) + 3
+    )
+    # tracing-off semantics unchanged: the tracer's own counters stay zero
+    assert tracer.steps == 0 and tracer.metrics()["steps"] == 0
+
+
+def test_step_tracer_step_body_exception_propagates():
+    tracer = StepTracer(cfg.get_default(), "speed")
+    with pytest.raises(RuntimeError):
+        with tracer.step("generation"):
+            raise RuntimeError("must not be swallowed by the finally")
+
+
+# ---------------------------------------------------------------------------
+# topic produce/consume/failure counters
+# ---------------------------------------------------------------------------
+
+
+def test_topic_counters_record_produce_consume_and_failures():
+    tp.reset_memory_brokers()
+    reg = metrics_mod.default_registry()
+    topic = "OryxMetricsT"
+    label = f'topic="{topic}"'
+    before = reg.snapshot()
+    broker = tp.get_broker("memory:metrics-test")
+    broker.create_topic(topic)
+    producer = tp.TopicProducerImpl("memory:metrics-test", topic, max_size=8)
+    producer.send("k", "short")
+    producer.send("k", "short2")
+    with pytest.raises(tp.TopicException):
+        producer.send("k", "x" * 100)  # transport cap -> send failure
+    it = tp.ConsumeDataIterator(broker, topic, "earliest")
+    assert next(it).message == "short"
+    assert next(it).message == "short2"
+    it.close()
+    after = reg.snapshot()
+    assert _get(after, "oryx_topic_produced_total", label) - _get(
+        before, "oryx_topic_produced_total", label) == 2
+    assert _get(after, "oryx_topic_send_failures_total", label) - _get(
+        before, "oryx_topic_send_failures_total", label) == 1
+    assert _get(after, "oryx_topic_consumed_total", label) - _get(
+        before, "oryx_topic_consumed_total", label) == 2
+    tp.reset_memory_brokers()
+
+
+# ---------------------------------------------------------------------------
+# coalescer flush metrics
+# ---------------------------------------------------------------------------
+
+
+class _FakeModel:
+    features = 4
+
+    def top_n_batch(self, qs, want, alloweds=None, excluded=None):
+        time.sleep(0.005)  # force arrivals to queue behind the in-flight call
+        return [[("i0", 1.0)]] * len(qs)
+
+
+def test_coalescer_flush_updates_batch_size_histogram():
+    from oryx_tpu.serving.batcher import TopNCoalescer
+
+    reg = metrics_mod.default_registry()
+    before = reg.snapshot()
+    model = _FakeModel()
+
+    async def drive():
+        coal = TopNCoalescer(window_ms=0.5, max_batch=8, max_inflight=1)
+        results = await asyncio.gather(
+            *[coal.top_n(model, np.zeros(4, np.float32), 1) for _ in range(6)]
+        )
+        assert all(r == [("i0", 1.0)] for r in results)
+
+    asyncio.run(drive())
+    after = reg.snapshot()
+    flushes = _get(after, "oryx_coalescer_batch_size_count") - _get(
+        before, "oryx_coalescer_batch_size_count")
+    total_requests = _get(after, "oryx_coalescer_batch_size_sum") - _get(
+        before, "oryx_coalescer_batch_size_sum")
+    assert flushes >= 1
+    assert total_requests == 6  # histogram sum counts real (pre-pad) requests
+    # queue drained at the end
+    assert _get(after, "oryx_coalescer_queue_depth") == 0
+
+
+# ---------------------------------------------------------------------------
+# middleware + /metrics endpoint over a real aiohttp server
+# ---------------------------------------------------------------------------
+
+
+class _FakeServingModel:
+    def get_fraction_loaded(self):
+        return 1.0
+
+
+class _FakeManager:
+    rescorer_provider = None
+
+    def get_model(self):
+        return _FakeServingModel()
+
+    def is_read_only(self):
+        return True
+
+
+class _AppServer:
+    """Run an aiohttp app on a free port in a thread (the test is the client)."""
+
+    def __init__(self, app):
+        self.port = ioutils.choose_free_port()
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._app = app
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        asyncio.set_event_loop(self._loop)
+        runner = web.AppRunner(self._app, access_log=None)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        self._loop.run_until_complete(site.start())
+        self._started.set()
+        self._loop.run_forever()
+        self._loop.run_until_complete(runner.cleanup())
+
+    def __enter__(self) -> str:
+        self._thread.start()
+        assert self._started.wait(15), "app server failed to start"
+        return f"http://127.0.0.1:{self.port}"
+
+    def __exit__(self, *exc):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+
+def _app_config(extra: dict):
+    return cfg.overlay_on(extra, cfg.get_default())
+
+
+def test_middleware_records_status_latency_and_routes():
+    app = make_app(_app_config({}), _FakeManager())
+    reg = metrics_mod.default_registry()
+    before = reg.snapshot()
+    with _AppServer(app) as base:
+        client = httpx.Client(base_url=base, timeout=30)
+        assert client.get("/ready").status_code == 200
+        assert client.get("/nope").status_code == 404
+        assert client.get("/error", params={"status": "500"}).status_code == 500
+        client.close()
+    after = reg.snapshot()
+
+    def delta(label):
+        return _get(after, "oryx_serving_requests_total", label) - _get(
+            before, "oryx_serving_requests_total", label)
+
+    assert delta('route="/ready",method="GET",status="200"') == 1
+    assert delta('route="unmatched",method="GET",status="404"') == 1
+    assert delta('route="/error",method="GET",status="500"') == 1
+    # latency histogram observed per request on the matched template
+    assert _get(after, "oryx_serving_request_latency_seconds_count",
+                'route="/ready"') - _get(
+        before, "oryx_serving_request_latency_seconds_count",
+        'route="/ready"') == 1
+    # in-flight gauge settled back to zero
+    assert _get(after, "oryx_serving_requests_in_flight") == 0
+
+
+def test_metrics_endpoint_auth_exempt_by_default():
+    app = make_app(_app_config({
+        "oryx.serving.api.user-name": "admin",
+        "oryx.serving.api.password": "s3cret",
+        "oryx.serving.api.auth-scheme": "basic",
+    }), _FakeManager())
+    with _AppServer(app) as base:
+        client = httpx.Client(base_url=base, timeout=30)
+        # API routes stay behind auth...
+        assert client.get("/ready").status_code == 401
+        assert client.get("/ready", auth=("admin", "s3cret")).status_code == 200
+        # ...but the scrape endpoint is reachable without credentials
+        r = client.get("/metrics")
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "oryx_serving_requests_total" in r.text
+        client.close()
+
+
+def test_metrics_endpoint_opt_in_auth():
+    app = make_app(_app_config({
+        "oryx.serving.api.user-name": "admin",
+        "oryx.serving.api.password": "s3cret",
+        "oryx.serving.api.auth-scheme": "basic",
+        "oryx.metrics.require-auth": True,
+    }), _FakeManager())
+    with _AppServer(app) as base:
+        client = httpx.Client(base_url=base, timeout=30)
+        assert client.get("/metrics").status_code == 401
+        assert client.get(
+            "/metrics", auth=("admin", "s3cret")
+        ).status_code == 200
+        client.close()
+
+
+def test_context_path_runs_middlewares_once_and_exempts_metrics():
+    """Regression for the double-middleware bug: with a non-root
+    context-path the same middleware list used to be installed on BOTH the
+    outer app and the subapp, so auth/compression (and now metrics) ran
+    twice per request."""
+    app = make_app(_app_config({
+        "oryx.serving.api.context-path": "/oryx",
+        "oryx.serving.api.user-name": "admin",
+        "oryx.serving.api.password": "s3cret",
+        "oryx.serving.api.auth-scheme": "basic",
+    }), _FakeManager())
+    reg = metrics_mod.default_registry()
+    before = reg.snapshot()
+    with _AppServer(app) as base:
+        client = httpx.Client(base_url=base, timeout=30)
+        assert client.get(
+            "/oryx/ready", auth=("admin", "s3cret")
+        ).status_code == 200
+        # auth exemption still applies through the subapp's route table
+        assert client.get("/oryx/metrics").status_code == 200
+        client.close()
+    after = reg.snapshot()
+    # exactly ONE count for the request (the subapp resource reports its
+    # canonical with the context-path prefix)
+    label = 'route="/oryx/ready",method="GET",status="200"'
+    assert _get(after, "oryx_serving_requests_total", label) - _get(
+        before, "oryx_serving_requests_total", label) == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real ServingLayer, traffic + one MODEL handoff
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serving_metrics(tmp_path_factory):
+    from oryx_tpu.models.als import data as d
+    from oryx_tpu.models.als import pmml_codec
+    from oryx_tpu.models.als import train as tr
+    from oryx_tpu.pmml import pmmlutils
+
+    tp.reset_memory_brokers()
+    tmp_path = tmp_path_factory.mktemp("als-metrics-model")
+    rng = np.random.default_rng(0)
+    scores = rng.standard_normal((25, 3)) @ rng.standard_normal((3, 15))
+    lines = []
+    for u in range(25):
+        for i in np.argsort(-scores[u])[:5]:
+            lines.append(f"u{u},i{i},1,{u * 100 + int(i)}")
+    batch = d.prepare(lines, implicit=True)
+    x, y = tr.als_train(batch, features=4, lam=0.001, alpha=1.0, implicit=True,
+                        iterations=3, chunk=256)
+    pmml = pmml_codec.model_to_pmml(
+        np.asarray(x), np.asarray(y), batch.users.index_to_id,
+        batch.items.index_to_id, 4, 0.001, 1.0, True, False, 1e-5, tmp_path,
+    )
+    pmml_str = pmmlutils.to_string(pmml)
+    known = {}
+    for it in d.parse_lines(lines):
+        known.setdefault(it.user, []).append(it.item)
+
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources": "oryx_tpu.serving.resources.als",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    prod = tp.TopicProducerImpl("memory:", "OryxUpdate")
+    prod.send("MODEL", pmml_str)
+    for id_, vec in pmml_codec.read_features(tmp_path / "Y"):
+        prod.send("UP", json.dumps(["Y", id_, [float(v) for v in vec]]))
+    for id_, vec in pmml_codec.read_features(tmp_path / "X"):
+        prod.send("UP", json.dumps(
+            ["X", id_, [float(v) for v in vec], known.get(id_, [])]))
+
+    layer = ServingLayer(config)
+    layer.start()
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get("/ready").status_code == 200:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("serving layer never became ready")
+    yield client, layer, batch, prod, pmml_str
+    client.close()
+    layer.close()
+    tp.reset_memory_brokers()
+
+
+def _metric_value(text: str, name: str) -> float:
+    m = re.search(rf"^{re.escape(name)} (\S+)$", text, re.MULTILINE)
+    assert m, f"{name} not found in exposition"
+    return float(m.group(1))
+
+
+def test_metrics_end_to_end_after_traffic_and_handoff(serving_metrics):
+    client, layer, batch, prod, pmml_str = serving_metrics
+    users = batch.users.index_to_id[:8]
+    for u in users:
+        assert client.get(f"/recommend/{u}").status_code == 200
+
+    before = client.get("/metrics").text
+    gen_before = _metric_value(before, "oryx_serving_model_generation_total")
+
+    # one MODEL handoff mid-flight; the consumer thread picks it up
+    prod.send("MODEL", pmml_str)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        text = client.get("/metrics").text
+        if _metric_value(text, "oryx_serving_model_generation_total") > gen_before:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("model-generation counter never advanced after handoff")
+
+    # request-latency histogram series for the traffic we produced
+    assert re.search(
+        r'oryx_serving_request_latency_seconds_bucket\{route="/recommend/\{userID\}",le="[^"]+"\} \d+',
+        text,
+    )
+    assert 'oryx_serving_requests_total{route="/recommend/{userID}",method="GET",status="200"}' in text
+    # coalescer batch-size histogram saw the /recommend device calls
+    assert re.search(r'oryx_coalescer_batch_size_bucket\{le="[^"]+"\} \d+', text)
+    assert _metric_value(text, "oryx_coalescer_batch_size_count") >= 1
+    # update-consumer lag gauges (messages + seconds since last update)
+    assert _metric_value(text, "oryx_serving_update_lag_messages") >= 0
+    assert _metric_value(text, "oryx_serving_update_lag_seconds") >= 0
+    # model load fraction evaluated at scrape time on the live manager
+    assert _metric_value(text, "oryx_serving_model_load_fraction") > 0.5
+    # hot-path instrumentation: batched top-N device calls were timed
+    assert _metric_value(text, "oryx_serving_topn_batch_seconds_count") >= 1
+    # topic counters carry the update topic's traffic
+    assert re.search(r'oryx_topic_consumed_total\{topic="OryxUpdate"\} \d+', text)
+    # the console advertises the scrape endpoint
+    assert "/metrics" in client.get("/").text
+
+
+def test_trace_summary_reads_metrics_dump_and_url(serving_metrics, tmp_path, capsys):
+    from oryx_tpu.tools import trace_summary
+
+    client = serving_metrics[0]
+    port = str(client.base_url).rsplit(":", 1)[1].strip("/")
+    # URL mode straight off the live registry
+    rc = trace_summary.main([f"http://127.0.0.1:{port}/metrics", "--top", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "oryx_serving_request_latency_seconds" in out
+    assert "histograms" in out
+    # file mode with sniffing (no --metrics flag)
+    dump = tmp_path / "metrics.txt"
+    dump.write_text(client.get("/metrics").text)
+    rc = trace_summary.main([str(dump)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "oryx_step_duration_seconds" in out or "oryx_serving" in out
+
+
+def test_serving_layer_close_joins_warmer():
+    """The batch warmer must be joined (bounded) on close so no thread
+    keeps touching a closed manager; also covers the Thread._stop shadowing
+    regression (join() used to raise TypeError)."""
+    tp.reset_memory_brokers()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.compute.precompile-batches": True,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    layer = ServingLayer(config)
+    layer.start()
+    try:
+        assert layer._warmer is not None and layer._warmer.is_alive()
+    finally:
+        layer.close()
+    assert not layer._warmer.is_alive()
+    tp.reset_memory_brokers()

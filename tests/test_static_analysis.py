@@ -12,11 +12,24 @@ import json
 import os
 import textwrap
 
+import pytest
+
 import oryx_tpu
 from oryx_tpu.tools.analyze import analyze_project, analyze_source
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(oryx_tpu.__file__)))
 BASELINE = os.path.join(REPO_ROOT, "conf", "analyze-baseline.json")
+
+
+@pytest.fixture(scope="module")
+def project_analysis():
+    """One full-package analyze_project sweep shared by every gate test
+    below (the project AST walk is the expensive part)."""
+    return analyze_project(
+        [os.path.join(REPO_ROOT, "oryx_tpu")],
+        root=REPO_ROOT,
+        baseline_path=BASELINE,
+    )
 
 
 def _run(src: str, checker: str, **kw):
@@ -445,14 +458,10 @@ def test_stale_suppression_is_flagged():
 # ---------------------------------------------------------------------------
 
 
-def test_package_has_no_unsuppressed_findings():
+def test_package_has_no_unsuppressed_findings(project_analysis):
     """`python -m oryx_tpu.cli analyze` must exit 0 over oryx_tpu/ at HEAD:
     new hazards either get fixed or get a justified suppression."""
-    result = analyze_project(
-        [os.path.join(REPO_ROOT, "oryx_tpu")],
-        root=REPO_ROOT,
-        baseline_path=BASELINE,
-    )
+    result = project_analysis
     assert result.parse_errors == []
     assert result.unsuppressed == [], "\n" + "\n".join(
         f.render() for f in result.unsuppressed
@@ -460,6 +469,27 @@ def test_package_has_no_unsuppressed_findings():
     # every suppression carries a real justification
     for f in result.suppressed:
         assert f.justification and not f.justification.startswith("TODO"), f.render()
+
+
+def test_metrics_keys_are_declared_and_read(project_analysis):
+    """The oryx.metrics.* surface must stay wired end to end: every key
+    declared in reference_conf is read by code and vice versa — zero
+    config-key-drift findings (suppressed or not) may mention the
+    namespace, so the metrics registry can never grow dead or typo'd
+    knobs behind a baseline entry."""
+    result = project_analysis
+    drift = [
+        f for f in list(result.unsuppressed) + list(result.suppressed)
+        if f.checker == "config-key-drift" and "oryx.metrics" in (f.symbol or "")
+    ]
+    assert drift == [], "\n" + "\n".join(f.render() for f in drift)
+    # and the declared defaults really resolve through the config tree
+    from oryx_tpu.common import config as cfg
+
+    conf = cfg.get_default()
+    assert conf.get_bool("oryx.metrics.enabled") is True
+    assert conf.get_bool("oryx.metrics.require-auth") is False
+    assert conf.get_int("oryx.metrics.max-label-cardinality") > 0
 
 
 def test_cli_analyze_json_exit_zero(capsys):
