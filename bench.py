@@ -157,6 +157,42 @@ def _serving_bench() -> dict:
         lats.append((time.perf_counter() - t1) * 1000.0)
     lats.sort()
 
+    # Trace-recording overhead: the same batched loop with one device-call
+    # span per call (exactly what the coalescer records per flush), spans
+    # enabled vs disabled — measures what oryx.tracing.spans.enabled costs
+    # on this machine rather than asserting it anecdotally.
+    from oryx_tpu.common import spans as spans_mod
+
+    def traced_window(seconds: float = 1.5) -> float:
+        n = 0
+        t = time.perf_counter()
+        while time.perf_counter() - t < seconds:
+            start = n % N_QUERY_USERS
+            b = queries[start:start + BATCH]
+            if len(b) < BATCH:
+                b = queries[:BATCH]
+            with spans_mod.span(
+                "bench.top_n_batch", parent=None,
+                attributes={"route": "bench.top_n_batch",
+                            "batch.size": len(b)},
+            ):
+                model.top_n_batch(b, HOW_MANY)
+            n += len(b)
+        return n / (time.perf_counter() - t)
+
+    spans_mod.set_enabled(True)
+    spans_on_qps = traced_window()
+    spans_mod.set_enabled(False)
+    spans_off_qps = traced_window()
+    spans_mod.set_enabled(True)  # HTTP section below runs traced
+    tracing_overhead = {
+        "spans_on_qps": round(spans_on_qps, 1),
+        "spans_off_qps": round(spans_off_qps, 1),
+        "overhead_pct": round(
+            100.0 * (spans_off_qps - spans_on_qps) / spans_off_qps, 2
+        ) if spans_off_qps else None,
+    }
+
     # HTTP path: the reference's 437 qps was measured at the endpoint
     # (LoadBenchmark.java:37-110). Serve the same model through the real
     # aiohttp layer + request coalescer and drive it with concurrent clients.
@@ -164,6 +200,19 @@ def _serving_bench() -> dict:
         http_section = _http_bench(model, queries)
     except Exception as e:  # noqa: BLE001 — optional section
         http_section = {"error": f"{type(e).__name__}: {e}"}
+
+    # the 5 slowest spans the round produced (reservoir retention keeps the
+    # slowest per route through ring wrap): the p99 note "includes
+    # first-compiles inside the timed window" is now a concrete list of
+    # traces with batch-size/pad-waste/queue-wait attributes, not anecdote
+    recorder = spans_mod.default_recorder()
+    slowest_traces = [
+        s.to_dict()
+        for s in sorted(
+            (s for kept in recorder.slowest().values() for s in kept),
+            key=lambda s: -s.duration,
+        )[:5]
+    ]
 
     # LSH sample-rate 0.3 run — the reference's own best configuration,
     # exercising the per-query LUT masking path
@@ -212,6 +261,8 @@ def _serving_bench() -> dict:
             "unit": "recs/s",
             "vs_baseline": round(lsh_qps / BASELINE_QPS, 2),
         },
+        "tracing_overhead": tracing_overhead,
+        "slowest_traces": slowest_traces,
         "http": http_section,
     }
 

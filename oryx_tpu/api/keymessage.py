@@ -1,12 +1,16 @@
 """Key/message pair flowing through topics.
 
 Equivalent of the reference's KeyMessage/KeyMessageImpl
-(framework/oryx-api/.../KeyMessage.java:34-40, KeyMessageImpl.java).
+(framework/oryx-api/.../KeyMessage.java:34-40, KeyMessageImpl.java), plus
+transport-level ``headers`` (Kafka record headers equivalent) carrying
+cross-tier metadata — today the W3C ``traceparent`` injected by
+TopicProducerImpl so a trace minted at HTTP ingress survives the topic hop
+into the speed/batch tiers (common/spans.py).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Generic, TypeVar
 
 K = TypeVar("K")
@@ -17,6 +21,9 @@ M = TypeVar("M")
 class KeyMessage(Generic[K, M]):
     key: K
     message: M
+    #: Transport metadata (e.g. {"traceparent": ...}); excluded from
+    #: equality so payload comparison semantics predate headers.
+    headers: "dict | None" = field(default=None, compare=False)
 
     def get_key(self) -> K:
         return self.key

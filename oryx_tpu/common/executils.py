@@ -16,6 +16,11 @@ T = TypeVar("T")
 
 log = logging.getLogger(__name__)
 
+# NOTE for async handlers: hop off the event loop with ``asyncio.to_thread``
+# (NOT ``loop.run_in_executor``, which does not copy contextvars on this
+# Python and silently severs the tracing current-span — common/spans.py —
+# at every executor hop; tests/test_spans.py pins the difference).
+
 
 def do_in_parallel(num_tasks: int, fn: Callable[[int], None], parallelism: int | None = None) -> None:
     """Run fn(0..num_tasks-1), up to ``parallelism`` at a time."""

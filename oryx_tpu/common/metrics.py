@@ -37,12 +37,20 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from bisect import bisect_left
 from typing import Callable, Iterable
 
 #: Content-Type for the text exposition format (Prometheus scrapers send
-#: Accept for 0.0.4; we always answer with it).
+#: Accept for 0.0.4; the default answer).
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Content-Type for the OpenMetrics exposition — the only text format that
+#: carries exemplars. GET /metrics answers with it (and renders exemplars)
+#: when the scraper's Accept header asks for openmetrics.
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 #: Fixed log-scale latency buckets (seconds): 0.5 ms – 10 s, the serving
 #: request/device-call range. Sub-bucket resolution follows the usual
@@ -88,6 +96,17 @@ def _label_str(labelnames: tuple, labelvalues: tuple) -> str:
     )
 
 
+def _exemplar_suffix(ex: "tuple | None") -> str:
+    """OpenMetrics exemplar: `` # {trace_id="…"} value timestamp``. Only the
+    openmetrics render emits these — the 0.0.4 text parser would reject the
+    suffix."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{_escape_label(str(trace_id))}"}} '
+            f"{_fmt(value)} {ts:.3f}")
+
+
 class _NullChild:
     """Sink for label sets past the cardinality cap: accepts every update,
     stores nothing (the drop already got counted)."""
@@ -104,7 +123,7 @@ class _NullChild:
     def set_function(self, fn: "Callable[[], float] | None") -> None:
         pass
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: "str | None" = None) -> None:
         pass
 
 
@@ -187,7 +206,8 @@ class _GaugeChild:
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_reg", "_bounds", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_reg", "_bounds", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, lock: threading.Lock, reg: "MetricsRegistry",
                  bounds: tuple):
@@ -197,8 +217,12 @@ class _HistogramChild:
         self._counts = [0] * (len(bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (trace_id, value, walltime): the LAST exemplar
+        # per bucket, so a bad latency bucket points at a concrete trace
+        # (common/spans.py). Lazily allocated — most histograms never see one.
+        self._exemplars: "dict[int, tuple] | None" = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: "str | None" = None) -> None:
         if not self._reg.enabled:
             return
         # bucket search outside the lock: bounds are immutable
@@ -207,6 +231,10 @@ class _HistogramChild:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (exemplar, value, time.time())
 
     @property
     def count(self) -> int:
@@ -220,13 +248,17 @@ class _HistogramChild:
 
     def _snapshot(self) -> tuple:
         with self._lock:
-            return list(self._counts), self._sum, self._count
+            return (
+                list(self._counts), self._sum, self._count,
+                dict(self._exemplars) if self._exemplars else {},
+            )
 
     def _reset(self) -> None:
         with self._lock:
             self._counts = [0] * (len(self._bounds) + 1)
             self._sum = 0.0
             self._count = 0
+            self._exemplars = None
 
 
 class _Family:
@@ -287,7 +319,7 @@ class _Family:
     def inc(self, amount: float = 1.0) -> None:
         self._default.inc(amount)
 
-    def render_samples(self, out: list) -> None:
+    def render_samples(self, out: list, exemplars: bool = False) -> None:
         raise NotImplementedError
 
     def snapshot_into(self, out: dict) -> None:
@@ -304,7 +336,7 @@ class Counter(_Family):
     def value(self) -> float:
         return self._default.value
 
-    def render_samples(self, out: list) -> None:
+    def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
             ls = _label_str(self.labelnames, key)
             out.append(f"{self.name}{{{ls}}} {_fmt(child.value)}" if ls
@@ -336,7 +368,7 @@ class Gauge(_Family):
     def value(self) -> float:
         return self._default.value
 
-    def render_samples(self, out: list) -> None:
+    def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
             ls = _label_str(self.labelnames, key)
             out.append(f"{self.name}{{{ls}}} {_fmt(child.value)}" if ls
@@ -365,8 +397,8 @@ class Histogram(_Family):
     def _make_child(self) -> _HistogramChild:
         return _HistogramChild(self._lock, self._registry, self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default.observe(value)
+    def observe(self, value: float, exemplar: "str | None" = None) -> None:
+        self._default.observe(value, exemplar)
 
     @property
     def count(self) -> int:
@@ -376,18 +408,21 @@ class Histogram(_Family):
     def sum(self) -> float:
         return self._default.sum
 
-    def render_samples(self, out: list) -> None:
+    def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
-            counts, total, n = child._snapshot()
+            counts, total, n, exs = child._snapshot()
             base = _label_str(self.labelnames, key)
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 ls = f"{base},le=\"{_fmt(bound)}\"" if base else f'le="{_fmt(bound)}"'
-                out.append(f"{self.name}_bucket{{{ls}}} {cum}")
+                out.append(f"{self.name}_bucket{{{ls}}} {cum}"
+                           + _exemplar_suffix(exs.get(i) if exemplars else None))
             cum += counts[-1]
             ls = f'{base},le="+Inf"' if base else 'le="+Inf"'
-            out.append(f"{self.name}_bucket{{{ls}}} {cum}")
+            out.append(f"{self.name}_bucket{{{ls}}} {cum}"
+                       + _exemplar_suffix(
+                           exs.get(len(self.buckets)) if exemplars else None))
             out.append(f"{self.name}_sum{{{base}}} {_fmt(total)}" if base
                        else f"{self.name}_sum {_fmt(total)}")
             out.append(f"{self.name}_count{{{base}}} {n}" if base
@@ -397,7 +432,7 @@ class Histogram(_Family):
         counts = out.setdefault(f"{self.name}_count", {})
         sums = out.setdefault(f"{self.name}_sum", {})
         for key, child in self._items():
-            _, total, n = child._snapshot()
+            _, total, n, _exs = child._snapshot()
             ls = _label_str(self.labelnames, key)
             counts[ls] = n
             sums[ls] = total
@@ -459,17 +494,34 @@ class MetricsRegistry:
                   buckets: Iterable = LATENCY_BUCKETS) -> Histogram:
         return self._register("histogram", name, help_, labelnames, buckets)
 
+    def get(self, name: str) -> "_Family | None":
+        """Registered family by name (health probes read gauges this way
+        instead of importing every instrumenting module)."""
+        with self._lock:
+            return self._families.get(name)
+
     # -- output ---------------------------------------------------------------
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (format 0.0.4), families sorted by
-        name, children by label values — deterministic for golden tests."""
+        name, children by label values — deterministic for golden tests.
+        ``exemplars=True`` renders OpenMetrics instead: same samples plus
+        per-bucket trace-id exemplars and the ``# EOF`` terminator."""
         with self._lock:
             fams = sorted(self._families.values(), key=lambda f: f.name)
         out: list[str] = []
         for fam in fams:
-            out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
-            out.append(f"# TYPE {fam.name} {fam.kind}")
-            fam.render_samples(out)
+            family = fam.name
+            if exemplars and fam.kind == "counter" and family.endswith("_total"):
+                # OpenMetrics names the counter FAMILY without the suffix
+                # and its samples '<family>_total'; announcing the family
+                # as 'x_total' makes strict parsers (Prometheus negotiates
+                # this format by default) reject the whole scrape
+                family = family[: -len("_total")]
+            out.append(f"# HELP {family} {_escape_help(fam.help)}")
+            out.append(f"# TYPE {family} {fam.kind}")
+            fam.render_samples(out, exemplars=exemplars)
+        if exemplars:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:

@@ -51,4 +51,7 @@ def register(app: web.Application) -> None:
         ("POST", "/add/{line}", "append a line of text"),
         ("POST", "/add", "append lines from the body"),
         ("GET", "/metrics", "Prometheus metrics exposition"),
+        ("GET", "/trace", "recent + slowest-per-route request traces"),
+        ("GET", "/healthz", "liveness probe"),
+        ("GET", "/readyz", "readiness probe (model loaded + update lag)"),
     ])

@@ -14,17 +14,17 @@ back consumed offsets; (4) TTL-GCs old data and model dirs.
 
 from __future__ import annotations
 
-import logging
 from typing import Sequence
 
 from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.lambda_rt.layer import AbstractLayer
 from oryx_tpu.store.datastore import DataStore, ModelStore
 from oryx_tpu.transport.topic import TopicProducerImpl
 
-log = logging.getLogger(__name__)
+log = spans.get_logger(__name__)
 
 # step duration/items ride the StepTracer→registry bridge (oryx_step_* with
 # tier="batch"); these add what the tracer cannot see — generations run and

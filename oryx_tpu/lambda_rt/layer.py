@@ -9,7 +9,6 @@ offsets keyed by ``oryx.id`` (buildInputDStream:208-211).
 
 from __future__ import annotations
 
-import logging
 import threading
 import time
 from typing import Callable, Sequence
@@ -17,11 +16,17 @@ from typing import Callable, Sequence
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import classutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
 from oryx_tpu.transport import topic as tp
 
-log = logging.getLogger(__name__)
+log = spans.get_logger(__name__)
+
+#: Per-generation cap on input-message continuation spans/links: a huge
+#: replayed batch must not turn one generation into 10^6 span records (the
+#: dropped remainder is still counted in the generation span's attributes).
+MAX_TRACED_INPUTS_PER_GENERATION = 128
 
 
 class AbstractLayer:
@@ -29,6 +34,7 @@ class AbstractLayer:
         self.config = config
         self.tier = tier
         metrics_mod.configure(config)  # batch/speed never build an HTTP app
+        spans.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")
@@ -118,8 +124,39 @@ class AbstractLayer:
                     offset += len(chunk)
                 offsets[p] = offset
             timestamp_ms = int(time.time() * 1000)
-            with self.tracer.step("generation", n_items=len(batch)):
-                on_batch(timestamp_ms, batch)
+            # trace continuation across the input-topic hop: each traced
+            # message gets a span parented into ITS ingress trace (so the
+            # HTTP trace that produced the event sees this tier process it),
+            # and the generation itself is a root span fan-in-LINKED to
+            # every traced message — the exact dual of the coalescer
+            traced = []
+            if spans.enabled():
+                traced = [
+                    km.headers[spans.TRACEPARENT] for km in batch
+                    if km.headers and spans.TRACEPARENT in km.headers
+                ]
+            n_traced = len(traced)
+            traced = traced[:MAX_TRACED_INPUTS_PER_GENERATION]
+            msg_spans = [
+                spans.start_span(
+                    f"{self.tier}.consume_input", parent=tp_,
+                    attributes={"route": f"{self.tier}-input",
+                                "batch_items": len(batch)},
+                )
+                for tp_ in traced
+            ]
+            try:
+                with spans.span(
+                    f"{self.tier}.generation", parent=None,
+                    links=[s.context for s in msg_spans],
+                    attributes={"route": f"{self.tier}.generation",
+                                "items": len(batch), "traced_inputs": n_traced},
+                ):
+                    with self.tracer.step("generation", n_items=len(batch)):
+                        on_batch(timestamp_ms, batch)
+            finally:
+                for s in msg_spans:
+                    spans.finish_span(s)
             self.store_input_offset(offsets)
 
     # -- threads / lifecycle ------------------------------------------------

@@ -14,16 +14,16 @@ SpeedLayerUpdate.java:51-63). Two concurrent activities:
 
 from __future__ import annotations
 
-import logging
 from typing import Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.api.speed import SpeedModelManager
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.lambda_rt.layer import AbstractLayer
 from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
 
-log = logging.getLogger(__name__)
+log = spans.get_logger(__name__)
 
 # microbatch duration/items ride the StepTracer→registry bridge (oryx_step_*
 # with tier="speed"); this counts the layer's OUTPUT — "UP" updates published
@@ -50,10 +50,16 @@ class SpeedLayer(AbstractLayer):
         )
         self._producer = TopicProducerImpl(self.update_broker, self.update_topic)
         log.info("starting speed layer; interval=%ss", interval_sec or self.generation_interval_sec)
-        # update-consumer thread (SpeedLayer.java:116-123)
+        # update-consumer thread (SpeedLayer.java:116-123); messages bearing
+        # a traceparent header (e.g. a batch-tier publish traced back to an
+        # ingress request) are processed under a span continuing that trace
+        traced_updates = spans.trace_consumed(
+            self._update_iterator, "speed.consume_update",
+            route="update-topic", attributes={"topic": self.update_topic},
+        )
         self.spawn(
             "OryxSpeedLayerUpdateConsumerThread",
-            lambda: self.model_manager.consume(self._update_iterator),
+            lambda: self.model_manager.consume(traced_updates),
         )
         # per-microbatch updates (SpeedLayerUpdate)
         start_offset = self.input_start_offset()

@@ -33,6 +33,7 @@ from oryx_tpu.api.serving import ServingModel
 from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
 from oryx_tpu.api.serving import AbstractServingModelManager
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.models.als import pmml_codec
 from oryx_tpu.models.als.lsh import LocalitySensitiveHash
 from oryx_tpu.models.als.rescorer import load_rescorer_providers
@@ -560,7 +561,11 @@ class ALSServingModel(ServingModel):
         try:
             return self._top_n_batch(query_vecs, how_many, alloweds, excluded)
         finally:
-            _TOPN_BATCH_SECONDS.observe(time.perf_counter() - t0)
+            # exemplar: the coalescer activates its device-call span around
+            # this call, so a slow bucket points at that concrete trace
+            _TOPN_BATCH_SECONDS.observe(
+                time.perf_counter() - t0, exemplar=spans.current_trace_id()
+            )
 
     def _top_n_batch(
         self,

@@ -17,7 +17,6 @@ import base64
 import hashlib
 import hmac
 import importlib
-import logging
 import re
 import secrets
 import ssl
@@ -29,10 +28,11 @@ from aiohttp import web
 from oryx_tpu.api.serving import ServingModelManager
 from oryx_tpu.common import classutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
 
-log = logging.getLogger(__name__)
+log = spans.get_logger(__name__)
 
 DEFAULT_RESOURCES = ["oryx_tpu.serving.resources.common"]
 
@@ -74,19 +74,49 @@ def _route_template(request: web.Request) -> str:
 @web.middleware
 async def _metrics_middleware(request, handler):
     """Outermost middleware: per-route request count/latency/status plus an
-    in-flight gauge. Counts what the client saw — auth 401s, mapped errors,
-    and 404s included."""
-    if not metrics_mod.default_registry().enabled:
+    in-flight gauge, and the request's INGRESS SPAN. Counts what the client
+    saw — auth 401s, mapped errors, and 404s included.
+
+    Tracing: an incoming W3C ``traceparent`` header continues the caller's
+    trace, otherwise a fresh trace is minted; the span is current for the
+    whole handler (asyncio carries the contextvar; executor hops go through
+    asyncio.to_thread, which copies it). The response echoes the trace via
+    ``traceparent``/``x-oryx-trace-id`` so a slow client call can be pulled
+    up by id from ``GET /trace``, and the request-latency histogram records
+    the trace id as its bucket exemplar — a bad bucket points at a trace."""
+    record = metrics_mod.default_registry().enabled
+    tracing = spans.enabled()
+    if not record and not tracing:
         return await handler(request)
-    _IN_FLIGHT.inc()
+    route = _route_template(request)
+    if record:
+        _IN_FLIGHT.inc()
     t0 = time.perf_counter()
     status = 500
+    trace_id = None
     try:
-        response = await handler(request)
-        status = response.status
-        return response
+        with spans.span(
+            f"http {request.method} {route}",
+            parent=spans.parse_traceparent(
+                request.headers.get(spans.TRACEPARENT)
+            ),
+            attributes={"route": route, "method": request.method},
+        ) as sp:
+            trace_id = sp.trace_id or None
+            response = await handler(request)
+            status = response.status
+            sp.set_attribute("status", status)
+            if trace_id:
+                response.headers[spans.TRACEPARENT] = sp.context.to_traceparent()
+                response.headers["x-oryx-trace-id"] = trace_id
+            return response
     except web.HTTPException as e:
         status = e.status
+        if trace_id:
+            # errors are exactly the responses an operator wants to pull up
+            # by id — the 404/401/4xx must carry the trace like any 200
+            e.headers[spans.TRACEPARENT] = sp.context.to_traceparent()
+            e.headers["x-oryx-trace-id"] = trace_id
         raise
     except asyncio.CancelledError:
         # client disconnect/timeout cancels the handler task: no response
@@ -94,10 +124,12 @@ async def _metrics_middleware(request, handler):
         status = "cancelled"
         raise
     finally:
-        _IN_FLIGHT.dec()
-        route = _route_template(request)
-        _REQUEST_LATENCY.labels(route).observe(time.perf_counter() - t0)
-        _REQUESTS.labels(route, request.method, str(status)).inc()
+        if record:
+            _IN_FLIGHT.dec()
+            _REQUEST_LATENCY.labels(route).observe(
+                time.perf_counter() - t0, exemplar=trace_id
+            )
+            _REQUESTS.labels(route, request.method, str(status)).inc()
 
 
 def _lag_seconds_fn(metered_ref):
@@ -108,47 +140,91 @@ def _lag_seconds_fn(metered_ref):
 
     def fn() -> float:
         metered = metered_ref()
-        last = metered._last_walltime if metered is not None else None
-        return 0.0 if last is None else max(0.0, time.time() - last)
+        if metered is None:
+            return 0.0
+        if metered._waiting:
+            # blocked in the broker pop = healthy and idle, not lagging —
+            # hours of quiet topic must never read as consumer staleness
+            return 0.0
+        return max(0.0, time.time() - metered._last_walltime)
+
+    return fn
+
+
+def _lag_messages_fn(metered_ref):
+    """Scrape-time messages-behind-head callback (weak ref, as above). The
+    broker probe runs at READ time, never on the consumer hot path — and a
+    WEDGED consumer still reports a live backlog, which an at-consume-time
+    ``set()`` could never do (its last value froze with the consumer)."""
+
+    def fn() -> float:
+        metered = metered_ref()
+        if metered is None:
+            return 0.0
+        try:
+            lag = metered._broker.total_size(metered._topic) - metered._consumed
+        except Exception:  # noqa: BLE001 — lag is advisory
+            return 0.0
+        return float(max(0, lag))
 
     return fn
 
 
 class _MeteredUpdates:
     """Iterator bridge feeding consumer-lag metrics from the update-consumer
-    thread: messages consumed, messages behind the broker head, and (via a
-    scrape-time gauge callback) seconds since the last consumed update.
+    thread: messages consumed, plus two scrape-time gauge callbacks —
+    messages behind the broker head and seconds since the consumer last
+    made progress (consumer start until the first message). Both evaluate
+    at READ time, so they stay truthful for a wedged consumer and /readyz
+    works even with the metrics kill switch off.
 
     ``broker`` must be the SAME instance the iterator consumes from (for
     ``file:`` brokers a fresh instance would rebuild a duplicate line index
-    just to answer total_size); the lag probe is skipped entirely when the
-    registry kill switch is off, since it is the one per-event cost here
-    that is broker I/O rather than arithmetic."""
+    just to answer total_size)."""
 
     def __init__(self, updates, broker, topic: str):
         import weakref
 
-        self._updates = updates
+        # trace continuation: a consumed message bearing a traceparent header
+        # is processed under a span continuing the trace minted at ingress
+        # (the span closes when the manager asks for the next message)
+        self._updates = iter(spans.trace_consumed(
+            updates, "serving.consume_update", route="update-topic",
+            attributes={"topic": topic},
+        ))
         self._broker = broker
         self._topic = topic
         self._consumed = 0
-        self._last_walltime: "float | None" = None
-        _UPDATE_LAG_SECONDS.set_function(_lag_seconds_fn(weakref.ref(self)))
+        # baseline at consumer start: "seconds since progress" must grow for
+        # a consumer that wedges before its FIRST message, not read 0 forever
+        self._last_walltime: float = time.time()
+        # True while blocked in the broker pop: healthy-idle, not lagging
+        # (plain bool, single-store/single-load atomic under the GIL)
+        self._waiting: bool = False
+        ref = weakref.ref(self)
+        _UPDATE_LAG_SECONDS.set_function(_lag_seconds_fn(ref))
+        _UPDATE_LAG_MESSAGES.set_function(_lag_messages_fn(ref))
 
     def __iter__(self) -> "_MeteredUpdates":
         return self
 
     def __next__(self):
-        km = next(self._updates)  # blocks on the consumer thread, never the loop
+        # entering = the manager finished the previous message: progress.
+        # The timestamps are NOT behind the metrics kill switch — /readyz
+        # derives staleness from them, and readiness must not depend on
+        # metrics. What still reads as stale is a consumer stuck INSIDE
+        # one message with more queued — size ready-max-lag-sec above the
+        # worst-case model-apply time.
+        self._last_walltime = time.time()
+        self._waiting = True
+        try:
+            km = next(self._updates)  # blocks on the consumer thread, never the loop
+        finally:
+            self._waiting = False
         self._consumed += 1
+        self._last_walltime = time.time()
         if metrics_mod.default_registry().enabled:
-            self._last_walltime = time.time()
             _UPDATES_CONSUMED.inc()
-            try:
-                lag = self._broker.total_size(self._topic) - self._consumed
-            except Exception:  # noqa: BLE001 — lag is advisory, consuming is not
-                lag = 0
-            _UPDATE_LAG_MESSAGES.set(max(0, lag))
         return km
 
 
@@ -169,6 +245,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     """Build the aiohttp application with resources from config
     (OryxApplication.java:54-96)."""
     metrics_mod.configure(config)
+    spans.configure(config)
     middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
     auth_mw = _auth_middleware(config)
     if auth_mw is not None:
@@ -217,16 +294,24 @@ def make_app(config, manager, input_producer=None) -> web.Application:
 _AUTH_REALM = "Oryx"
 
 
-def _metrics_canonicals(config) -> frozenset:
-    """Route templates that identify the /metrics resource — the bare
-    template plus the context-path-prefixed one (subapp resources report
-    their canonical WITH the prefix). Matching on the matched template, not
-    the raw path, means a crafted path can never spoof the exemption."""
+def _exempt_canonicals(config) -> frozenset:
+    """Route templates exempt from API auth — each listed bare plus
+    context-path-prefixed (subapp resources report their canonical WITH the
+    prefix). Matching on the matched template, not the raw path, means a
+    crafted path can never spoof the exemption.
+
+    ``/healthz``/``/readyz`` are ALWAYS exempt (load balancers cannot speak
+    digest, and the probes leak nothing beyond up/down); ``/metrics`` and
+    ``/trace`` are exempt unless ``oryx.metrics.require-auth``."""
+    templates = {"/healthz", "/readyz"}
+    if not config.get_bool("oryx.metrics.require-auth", False):
+        templates |= {"/metrics", "/trace"}
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
-    return frozenset({"/metrics", context_path.rstrip("/") + "/metrics"})
+    prefix = context_path.rstrip("/")
+    return frozenset(templates | {prefix + t for t in templates})
 
 
-def _is_metrics_route(request: web.Request, canonicals: frozenset) -> bool:
+def _is_exempt_route(request: web.Request, canonicals: frozenset) -> bool:
     resource = getattr(request.match_info.route, "resource", None)
     return getattr(resource, "canonical", None) in canonicals
 
@@ -235,16 +320,13 @@ def _auth_middleware(config):
     """Optional HTTP auth behind oryx.serving.api.{user-name,password}:
     DIGEST by default for wire parity with the reference's single-user
     InMemoryRealm (ServingLayer.java:293-321); ``auth-scheme = basic`` opts
-    into basic-over-TLS. GET /metrics is exempt unless
-    ``oryx.metrics.require-auth`` (Prometheus scrapers rarely speak digest)."""
+    into basic-over-TLS. GET /metrics and /trace are exempt unless
+    ``oryx.metrics.require-auth`` (Prometheus scrapers rarely speak digest);
+    the /healthz & /readyz probes are always exempt."""
     user = config.get_string("oryx.serving.api.user-name", None)
     if not user:
         return None
-    exempt = (
-        _metrics_canonicals(config)
-        if not config.get_bool("oryx.metrics.require-auth", False)
-        else frozenset()
-    )
+    exempt = _exempt_canonicals(config)
     password = config.get_string("oryx.serving.api.password", None) or ""
     scheme = config.get_string("oryx.serving.api.auth-scheme", "digest").lower()
     if scheme == "basic":
@@ -260,7 +342,7 @@ def _basic_auth_middleware(user: str, password: str,
 
     @web.middleware
     async def auth(request, handler):
-        if exempt and _is_metrics_route(request, exempt):
+        if exempt and _is_exempt_route(request, exempt):
             return await handler(request)
         header = request.headers.get("Authorization", "")
         if not hmac.compare_digest(header, f"Basic {expected}"):
@@ -315,7 +397,7 @@ def _digest_auth_middleware(user: str, password: str,
 
     @web.middleware
     async def auth(request, handler):
-        if exempt and _is_metrics_route(request, exempt):
+        if exempt and _is_exempt_route(request, exempt):
             return await handler(request)
         header = request.headers.get("Authorization", "")
         if not header.startswith("Digest "):

@@ -22,13 +22,13 @@ call runs in the default executor so the loop never blocks on the chip.
 from __future__ import annotations
 
 import asyncio
-import logging
 
 import numpy as np
 
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 
-log = logging.getLogger(__name__)
+log = spans.get_logger(__name__)
 
 _BATCH_SIZE = metrics_mod.default_registry().histogram(
     "oryx_coalescer_batch_size",
@@ -57,10 +57,10 @@ def floor_pow2(n: int) -> int:
 
 class _Pending:
     __slots__ = ("vec", "want", "how_many", "offset", "allowed", "excluded",
-                 "future", "enq_t")
+                 "future", "enq_t", "wait_span")
 
     def __init__(self, vec, how_many, offset, allowed, excluded, future,
-                 enq_t: float = 0.0):
+                 enq_t: float = 0.0, wait_span=None):
         self.vec = vec
         self.want = how_many + offset
         self.how_many = how_many
@@ -69,6 +69,10 @@ class _Pending:
         self.excluded = excluded
         self.future = future
         self.enq_t = enq_t
+        # queue-wait span: opened at enqueue as a child of the request's
+        # ingress span (contextvars do NOT cross the executor hop, so the
+        # span object itself is the carrier), closed at dispatch
+        self.wait_span = wait_span
 
 
 class TopNCoalescer:
@@ -116,9 +120,13 @@ class TopNCoalescer:
         """Coalesced equivalent of ``model.top_n(...)`` (no rescore)."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        wait_span = spans.start_span(
+            "coalescer.queue_wait",
+            attributes={"route": "coalescer.queue_wait"},
+        )
         self._pending.append((model, _Pending(
             np.asarray(query_vec, dtype=np.float32), how_many, offset,
-            allowed, excluded, fut, loop.time(),
+            allowed, excluded, fut, loop.time(), wait_span,
         )))
         self._maybe_flush(loop)
         return await fut
@@ -199,7 +207,36 @@ class TopNCoalescer:
             model, group = groups.pop(0)
             self._inflight += 1
             _BATCH_SIZE.observe(len(group))
-            loop.run_in_executor(None, self._execute, loop, model, group)
+            # queue wait ends at dispatch, and the device-call span OPENS
+            # here (not in the executor): the executor-scheduling handoff is
+            # part of what the request waits for, so it must be inside a
+            # span — otherwise the trace shows an unattributable gap. The
+            # call span opens BEFORE the wait spans close so a scheduling
+            # pause between the two timestamps reads as span overlap, never
+            # as an unattributed hole in the trace.
+            now = loop.time()
+            waits = [p.wait_span.context for p in group]
+            # parent = the first waiter; links = the OTHER waiters (linking
+            # the parent too would double-count that request in the fan-in)
+            call_span = spans.start_span(
+                "coalescer.device_call",
+                parent=waits[0],
+                links=[c for c in waits[1:] if c is not None],
+                attributes={
+                    "route": "coalescer.device_call",
+                    "batch.size": len(group),
+                    "queue_wait_max_ms": round(
+                        (now - min(p.enq_t for p in group)) * 1000.0, 3
+                    ),
+                },
+            )
+            for p in group:
+                p.wait_span.set_attribute(
+                    "queue_wait_ms", round((now - p.enq_t) * 1000.0, 3)
+                )
+                spans.finish_span(p.wait_span)
+            loop.run_in_executor(None, self._execute, loop, model, group,
+                                 call_span)
         for model, group in reversed(groups):
             self._pending[:0] = [(model, p) for p in group]
         _QUEUE_DEPTH.set(len(self._pending))
@@ -214,45 +251,56 @@ class TopNCoalescer:
             # timer here would idle the device for window_ms per cycle
             self._flush(loop)
 
-    def _execute(self, loop, model, group: list[_Pending]) -> None:
-        """Executor thread: ONE batched device call for the whole group."""
+    def _execute(self, loop, model, group: list[_Pending], call_span) -> None:
+        """Executor thread: ONE batched device call for the whole group.
+
+        The device call is a FAN-IN: ``call_span`` (opened at dispatch on
+        the loop) is parented into the first waiter's trace and *linked* to
+        every waiter's queue-wait span, so each participating trace can
+        find the shared call — and its batch-size/pad-waste attributes —
+        that answered it."""
         try:
-            qs = np.stack([p.vec for p in group])
-            want = max(p.want for p in group)
-            alloweds = (
-                [p.allowed for p in group]
-                if any(p.allowed is not None for p in group)
-                else None
-            )
-            excluded = (
-                [p.excluded for p in group]
-                if any(p.excluded for p in group)
-                else None
-            )
-            # pad the batch to a power of two: coalesced batch sizes vary
-            # per flush, and every distinct size would otherwise be a fresh
-            # XLA trace/compile of the batched top-N program — on a
-            # tunneled backend that is seconds of compile on the hot path
-            n_real = len(group)
-            n_pad = 1 << max(0, n_real - 1).bit_length()
-            if n_pad > n_real:
-                _PAD_WASTE.inc(n_pad - n_real)
-                qs = np.concatenate(
-                    [qs, np.repeat(qs[:1], n_pad - n_real, axis=0)]
+            with spans.activate(call_span):
+                qs = np.stack([p.vec for p in group])
+                want = max(p.want for p in group)
+                alloweds = (
+                    [p.allowed for p in group]
+                    if any(p.allowed is not None for p in group)
+                    else None
                 )
-                if alloweds is not None:
-                    alloweds = alloweds + [None] * (n_pad - n_real)
-                if excluded is not None:
-                    excluded = list(excluded) + [None] * (n_pad - n_real)
-            results = model.top_n_batch(qs, want, alloweds, excluded)
+                excluded = (
+                    [p.excluded for p in group]
+                    if any(p.excluded for p in group)
+                    else None
+                )
+                # pad the batch to a power of two: coalesced batch sizes vary
+                # per flush, and every distinct size would otherwise be a fresh
+                # XLA trace/compile of the batched top-N program — on a
+                # tunneled backend that is seconds of compile on the hot path
+                n_real = len(group)
+                n_pad = 1 << max(0, n_real - 1).bit_length()
+                call_span.set_attribute("batch.padded", n_pad)
+                call_span.set_attribute("pad.waste_rows", n_pad - n_real)
+                if n_pad > n_real:
+                    _PAD_WASTE.inc(n_pad - n_real)
+                    qs = np.concatenate(
+                        [qs, np.repeat(qs[:1], n_pad - n_real, axis=0)]
+                    )
+                    if alloweds is not None:
+                        alloweds = alloweds + [None] * (n_pad - n_real)
+                    if excluded is not None:
+                        excluded = list(excluded) + [None] * (n_pad - n_real)
+                results = model.top_n_batch(qs, want, alloweds, excluded)
             for p, res in zip(group, results):
                 out = res[p.offset:p.offset + p.how_many]
                 loop.call_soon_threadsafe(_set_result, p.future, out)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            call_span.record_exception(e)
             log.exception("coalesced top-N batch failed")
             for p in group:
                 loop.call_soon_threadsafe(_set_exception, p.future, e)
         finally:
+            spans.finish_span(call_span)
             loop.call_soon_threadsafe(self._done, loop)
 
 

@@ -21,6 +21,9 @@ from typing import Any
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common import spans
+
+log = spans.get_logger(__name__)
 
 MANAGER_KEY = "oryx.model-manager"
 INPUT_PRODUCER_KEY = "oryx.input-producer"
@@ -62,10 +65,13 @@ def send_input(request: web.Request, message: str) -> None:
 
 
 async def send_input_async(request: web.Request, message: str) -> None:
-    """send_input off the event loop (one executor hop per message)."""
-    await asyncio.get_running_loop().run_in_executor(
-        None, send_input, request, message
-    )
+    """send_input off the event loop (one executor hop per message).
+
+    ``asyncio.to_thread`` — NOT ``run_in_executor``, which drops contextvars
+    on this Python — so the producer in the worker thread still sees the
+    request's ingress span and stamps the message's traceparent header:
+    span continuity across the executor."""
+    await asyncio.to_thread(send_input, request, message)
 
 
 async def send_input_many(request: web.Request, messages: "list[str]") -> None:
@@ -76,7 +82,7 @@ async def send_input_many(request: web.Request, messages: "list[str]") -> None:
         for m in messages:
             send_input(request, m)
 
-    await asyncio.get_running_loop().run_in_executor(None, send_all)
+    await asyncio.to_thread(send_all)
 
 
 def check(condition: bool, message: str, status: int = 400) -> None:
@@ -213,7 +219,5 @@ async def error_middleware(request: web.Request, handler):
     except web.HTTPException:
         raise
     except Exception as e:  # noqa: BLE001 - uniform 500 mapping
-        import logging
-
-        logging.getLogger(__name__).exception("unhandled error in %s", request.path)
+        log.exception("unhandled error in %s", request.path)
         return web.json_response({"error": str(e), "status": 500}, status=500)

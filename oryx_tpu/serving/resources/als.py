@@ -42,7 +42,10 @@ def _rescorer_provider(request: web.Request):
 
 
 async def _run(request, fn, *args):
-    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+    # to_thread (not run_in_executor) carries contextvars: device work in
+    # the worker keeps the request's ingress span current, so spans opened
+    # inside (and any histogram exemplars) land in the right trace
+    return await asyncio.to_thread(fn, *args)
 
 
 async def _top_n(request, model, vec, how_many, offset, allowed, rescore,
@@ -434,4 +437,7 @@ def register(app: web.Application) -> None:
         ("DELETE", "/pref/{userID}/{itemID}", "delete a preference"),
         ("POST", "/ingest", "bulk CSV ingest"),
         ("GET", "/metrics", "Prometheus metrics exposition"),
+        ("GET", "/trace", "recent + slowest-per-route request traces"),
+        ("GET", "/healthz", "liveness probe"),
+        ("GET", "/readyz", "readiness probe (model loaded + update lag)"),
     ])

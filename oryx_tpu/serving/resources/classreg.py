@@ -113,4 +113,7 @@ def register(app: web.Application) -> None:
         ("GET", "/feature/importance", "all feature importances"),
         ("GET", "/feature/importance/{n}", "one feature's importance"),
         ("GET", "/metrics", "Prometheus metrics exposition"),
+        ("GET", "/trace", "recent + slowest-per-route request traces"),
+        ("GET", "/healthz", "liveness probe"),
+        ("GET", "/readyz", "readiness probe (model loaded + update lag)"),
     ])

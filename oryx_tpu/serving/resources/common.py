@@ -1,10 +1,14 @@
-"""Framework-level endpoints: /ready, /error, and /metrics.
+"""Framework-level endpoints: /ready, /error, /metrics, /trace, and probes.
 
 Equivalent of the reference's Ready (app/oryx-app-serving/.../Ready.java:33)
 and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35);
 /metrics is the Prometheus exposition of the process-wide registry
 (docs/observability.md) — the stand-in for the reference's Spark-UI/JMX
-visibility (SURVEY §5.1).
+visibility (SURVEY §5.1). /trace renders the span ring buffer
+(common/spans.py): recent spans, the kept-slowest per route, or one whole
+trace by id. /healthz (liveness) and /readyz (readiness: model loaded +
+update-consumer lag under ``oryx.serving.ready-max-lag-sec``) are the
+load-balancer probe pair — always auth-exempt.
 """
 
 from __future__ import annotations
@@ -13,6 +17,7 @@ from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 
 
@@ -25,6 +30,54 @@ async def ready(request: web.Request) -> web.Response:
         return web.Response(status=e.status)
 
 
+async def healthz(request: web.Request) -> web.Response:
+    """Liveness: the process is up and the event loop is serving requests.
+    Deliberately model-agnostic — a layer mid-model-load is alive (restart
+    nothing), it is just not READY (send no traffic: that is /readyz)."""
+    return web.json_response({"status": "ok"})
+
+
+def _gauge_value(name: str) -> float:
+    gauge = metrics_mod.default_registry().get(name)
+    value = float(gauge.value) if gauge is not None else 0.0
+    return 0.0 if value != value else value  # NaN (dead callback) -> unknown
+
+
+async def readyz(request: web.Request) -> web.Response:
+    """Readiness for load balancers: 200 only when (a) the model has passed
+    ``min-model-load-fraction`` (the PR-2 load-fraction gate) and (b) the
+    update consumer is not stale. Stale means BOTH gauges agree: messages
+    are waiting behind the broker head (``…update_lag_messages``, probed
+    live at read time) AND the consumer has made no progress for more than
+    ``oryx.serving.ready-max-lag-sec`` (0 disables the lag check) — a
+    quiet topic with nothing to consume is healthy however long it stays
+    quiet, while a wedged consumer with a backlog keeps serving the OLD
+    model silently, and this gate lets the balancer rotate that replica
+    out before users notice. Both gauges are scrape-time callbacks, so the
+    probe works even with ``oryx.metrics.enabled = false``."""
+    detail: dict = {}
+    ok = True
+    try:
+        rsrc.get_serving_model(request)
+        detail["model"] = "loaded"
+    except OryxServingException:
+        detail["model"] = "not loaded"
+        ok = False
+    config = request.app[rsrc.CONFIG_KEY]
+    max_lag = config.get_float("oryx.serving.ready-max-lag-sec", 600.0)
+    detail["ready_max_lag_sec"] = max_lag
+    if max_lag > 0:
+        lag_sec = _gauge_value("oryx_serving_update_lag_seconds")
+        lag_msgs = _gauge_value("oryx_serving_update_lag_messages")
+        detail["update_lag_sec"] = round(lag_sec, 3)
+        detail["update_lag_messages"] = int(lag_msgs)
+        if lag_msgs > 0 and lag_sec > max_lag:
+            detail["update_consumer"] = "stale"
+            ok = False
+    detail["status"] = "ready" if ok else "unavailable"
+    return web.json_response(detail, status=200 if ok else 503)
+
+
 async def error(request: web.Request) -> web.Response:
     """Error page aggregating status/message (ErrorResource)."""
     status = request.query.get("status", "500")
@@ -34,14 +87,59 @@ async def error(request: web.Request) -> web.Response:
 
 async def metrics(request: web.Request) -> web.Response:
     """Prometheus text exposition of the process-wide metrics registry.
-    Exempt from API auth unless ``oryx.metrics.require-auth``."""
-    body = metrics_mod.default_registry().render().encode("utf-8")
-    return web.Response(body=body,
-                        headers={"Content-Type": metrics_mod.CONTENT_TYPE})
+    Exempt from API auth unless ``oryx.metrics.require-auth``. An Accept
+    header asking for OpenMetrics gets that format WITH trace-id exemplars
+    on the latency histograms (the 0.0.4 text parser would reject them)."""
+    openmetrics = "application/openmetrics-text" in request.headers.get(
+        "Accept", ""
+    )
+    body = metrics_mod.default_registry().render(
+        exemplars=openmetrics
+    ).encode("utf-8")
+    content_type = (
+        metrics_mod.OPENMETRICS_CONTENT_TYPE if openmetrics
+        else metrics_mod.CONTENT_TYPE
+    )
+    return web.Response(body=body, headers={"Content-Type": content_type})
+
+
+async def trace(request: web.Request) -> web.Response:
+    """JSON view of the span ring buffer (auth story identical to /metrics).
+
+    ``?trace_id=<32hex>`` returns every buffered span of one trace (what
+    ``tools/trace_summary.py --trace-id`` renders as a tree); otherwise the
+    most recent ``?limit=`` spans (default 100) plus the kept-slowest spans
+    per route — the p99 outliers survive ring wrap by design."""
+    recorder = spans.default_recorder()
+    trace_id = request.query.get("trace_id")
+    if trace_id:
+        hits = recorder.spans(trace_id=trace_id)
+        return web.json_response({
+            "trace_id": trace_id,
+            "spans": [s.to_dict() for s in hits],
+        })
+    try:
+        limit = max(1, int(request.query.get("limit", "100")))
+    except ValueError as e:
+        raise OryxServingException(400, "bad limit") from e
+    return web.json_response({
+        "enabled": spans.enabled(),
+        "stats": recorder.stats(),
+        "recent": [s.to_dict() for s in recorder.spans(limit=limit)],
+        "slowest_by_route": {
+            route: [s.to_dict() for s in slow]
+            for route, slow in sorted(recorder.slowest().items())
+        },
+    })
 
 
 def register(app: web.Application) -> None:
     app.router.add_route("GET", "/ready", ready)
     app.router.add_route("HEAD", "/ready", ready)
+    app.router.add_route("GET", "/healthz", healthz)
+    app.router.add_route("HEAD", "/healthz", healthz)
+    app.router.add_route("GET", "/readyz", readyz)
+    app.router.add_route("HEAD", "/readyz", readyz)
     app.router.add_route("GET", "/error", error)
     app.router.add_route("GET", "/metrics", metrics)
+    app.router.add_route("GET", "/trace", trace)

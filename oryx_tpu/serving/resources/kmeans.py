@@ -77,4 +77,7 @@ def register(app: web.Application) -> None:
         ("POST", "/add/{datum}", "append a data point"),
         ("POST", "/add", "append data points from the body"),
         ("GET", "/metrics", "Prometheus metrics exposition"),
+        ("GET", "/trace", "recent + slowest-per-route request traces"),
+        ("GET", "/healthz", "liveness probe"),
+        ("GET", "/readyz", "readiness probe (model loaded + update lag)"),
     ])

@@ -6,6 +6,7 @@ from oryx_tpu.tools.analyze.checkers.blocking import BlockingAsyncChecker
 from oryx_tpu.tools.analyze.checkers.locks import LockDisciplineChecker
 from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
 from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
+from oryx_tpu.tools.analyze.checkers.logstyle import LogDisciplineChecker
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -14,4 +15,5 @@ ALL_CHECKERS = (
     LockDisciplineChecker(),
     ConfigKeyDriftChecker(),
     Float64PromotionChecker(),
+    LogDisciplineChecker(),
 )

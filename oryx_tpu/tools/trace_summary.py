@@ -18,10 +18,18 @@ Usage:
     python -m oryx_tpu.tools.trace_summary <trace-dir-or-file> [--top N]
         [--track SUBSTR]
     python -m oryx_tpu.tools.trace_summary <metrics-dump-or-url> [--metrics]
+    python -m oryx_tpu.tools.trace_summary <server-url-or-trace-json> \
+        --trace-id <32-hex id>
 
 A ``http(s)://`` argument is always fetched and read as a metrics dump
 (append ``/metrics`` yourself if you pass the bare server root); a file is
 sniffed (``# HELP``/``# TYPE``/sample lines) unless ``--metrics`` forces it.
+
+``--trace-id`` switches to the per-request tracing side (common/spans.py):
+the argument is a serving base URL (``/trace?trace_id=`` is appended) or a
+saved ``GET /trace`` JSON body, and the output is the span TREE of that one
+request — ingress, coalescer queue-wait, device call with batch-size and
+pad-waste attributes — the view that attributes a single p99 outlier.
 
 Trace mode: tracks whose process/thread name matches ``--track`` (default:
 device-ish tracks — 'device', 'tpu', 'stream', the CPU PjRt client)
@@ -214,19 +222,36 @@ def parse_metrics_text(text: str) -> tuple:
 def bucket_quantile(bucket_rows: list, count: float, q: float) -> float:
     """Estimate the q-quantile from cumulative buckets with the standard
     Prometheus linear interpolation inside the containing bucket (an upper-
-    bound-biased estimate — exactly what histogram_quantile() reports)."""
+    bound-biased estimate — exactly what histogram_quantile() reports).
+
+    Edge cases the cumulative walk must survive (regression-tested):
+
+      * an EMPTY containing bucket (``cum == prev_cum``) divides by zero
+        without the span guard — report the bucket's upper edge;
+      * a first bucket with ``le <= 0``: the walk's synthetic lower edge is
+        0.0, which sits ABOVE the bucket — interpolating from it would walk
+        the wrong direction, so report the upper edge like Prometheus does;
+      * non-monotone cumulative counts (a torn multi-line scrape): clamp
+        the interpolation fraction to [0, 1] so the estimate stays inside
+        the containing bucket instead of extrapolating past its edges.
+    """
     if count <= 0:
         return float("nan")
     target = q * count
     prev_le, prev_cum = 0.0, 0.0
+    first = True
     for le, cum in bucket_rows:
         if cum >= target:
             if le == float("inf"):
                 return prev_le  # open-ended bucket: report its lower edge
+            if first and le <= 0.0:
+                return le  # no meaningful lower edge below zero
             span = cum - prev_cum
             frac = (target - prev_cum) / span if span > 0 else 1.0
+            frac = min(1.0, max(0.0, frac))
             return prev_le + (le - prev_le) * frac
         prev_le, prev_cum = le, cum
+        first = False
     return bucket_rows[-1][0] if bucket_rows else float("nan")
 
 
@@ -274,6 +299,106 @@ def _print_metrics_summary(text: str, top: int) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# /trace mode: render one trace's spans as a tree (--trace-id)
+# ---------------------------------------------------------------------------
+
+
+def build_span_tree(spans: list) -> tuple:
+    """Returns (roots, children): span dicts from a ``GET /trace`` payload,
+    children keyed by parent span_id and ordered by start time. A span whose
+    parent is missing from the buffer (ring-evicted) is promoted to root so
+    the tree never silently drops it."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    roots = []
+    for s in sorted(spans, key=lambda s: s.get("start", 0.0)):
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    return roots, children
+
+
+def _span_line(s: dict, depth: int) -> str:
+    attrs = s.get("attributes") or {}
+    interesting = {
+        k: v for k, v in attrs.items()
+        if k in ("route", "status", "batch.size", "batch.padded",
+                 "pad.waste_rows", "queue_wait_ms", "queue_wait_max_ms",
+                 "items", "key")
+    }
+    extras = ""
+    if interesting:
+        extras = "  " + " ".join(f"{k}={v}" for k, v in sorted(interesting.items()))
+    links = s.get("links") or []
+    if links:
+        extras += f"  links={len(links)}"
+    status = s.get("status", "ok")
+    flag = "" if status == "ok" else f"  !{status}"
+    return (f"  {s.get('duration_ms', 0.0):10.3f} ms  "
+            f"{'  ' * depth}{s.get('name', '?')}"
+            f" [{s.get('span_id', '?')}]{extras}{flag}")
+
+
+def render_span_tree(payload: dict, out=None) -> int:
+    """Print the span tree for one trace (the ``--trace-id`` mode)."""
+    out = out if out is not None else sys.stdout
+    spans = payload.get("spans", [])
+    trace_id = payload.get("trace_id", "?")
+    if not spans:
+        print(f"trace {trace_id}: no spans buffered (evicted, or wrong id)",
+              file=out)
+        return 1
+    print(f"trace {trace_id}: {len(spans)} span(s)", file=out)
+    roots, children = build_span_tree(spans)
+    covered = sum(s.get("duration_ms", 0.0) for s in roots)
+
+    def walk(s, depth):
+        print(_span_line(s, depth), file=out)
+        for c in children.get(s["span_id"], []):
+            walk(c, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    print(f"  {'-' * 12}\n  root span total: {covered:.3f} ms", file=out)
+    return 0
+
+
+def _fetch_trace(arg: str, trace_id: str) -> dict:
+    """``arg`` is a server/trace URL or a JSON dump file (the saved body of
+    ``GET /trace``). URLs get ``/trace?trace_id=`` appended as needed."""
+    if arg.startswith(("http://", "https://")):
+        from urllib.parse import quote
+        from urllib.request import urlopen
+
+        url = arg.rstrip("/")
+        if not url.endswith("/trace"):
+            url += "/trace"
+        url += f"?trace_id={quote(trace_id)}"
+        with urlopen(url, timeout=10) as resp:  # noqa: S310 — operator URL
+            payload = json.loads(resp.read().decode("utf-8"))
+    else:
+        with open(arg, encoding="utf-8") as fh:
+            payload = json.load(fh)
+        # accept a per-trace dump OR a full /trace dump (recent + slowest);
+        # filter locally either way so a stale/wrong id reports "no spans".
+        # Dedup by span_id: a slow span sits in BOTH recent and the
+        # slowest-by-route reservoir of a full dump
+        pool = list(payload.get("spans", payload.get("recent", [])))
+        for slow in (payload.get("slowest_by_route") or {}).values():
+            pool.extend(slow)
+        seen: set = set()
+        hits = []
+        for s in pool:
+            if s.get("trace_id") == trace_id and s.get("span_id") not in seen:
+                seen.add(s.get("span_id"))
+                hits.append(s)
+        payload = {"trace_id": trace_id, "spans": hits}
+    return payload
+
+
 def _read_metrics_arg(path: str) -> str:
     if path.startswith(("http://", "https://")):
         from urllib.request import urlopen
@@ -289,6 +414,7 @@ def main(argv: "list[str] | None" = None) -> int:
     top = 15
     track_filter = None
     force_metrics = False
+    trace_id = None
     try:
         if "--top" in args:
             i = args.index("--top")
@@ -297,6 +423,10 @@ def main(argv: "list[str] | None" = None) -> int:
         if "--track" in args:
             i = args.index("--track")
             track_filter = args[i + 1]
+            del args[i:i + 2]
+        if "--trace-id" in args:
+            i = args.index("--trace-id")
+            trace_id = args[i + 1]
             del args[i:i + 2]
         if "--metrics" in args:
             force_metrics = True
@@ -307,6 +437,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(__doc__, file=sys.stderr)
         return 2
     path = args[0]
+    if trace_id is not None:
+        return render_span_tree(_fetch_trace(path, trace_id))
     if path.startswith(("http://", "https://")) or force_metrics:
         return _print_metrics_summary(_read_metrics_arg(path), top)
     if os.path.isfile(path) and not path.endswith((".gz", ".json")):

@@ -47,6 +47,9 @@ from typing import Iterator
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import spans
+
+log = spans.get_logger(__name__)
 
 _PRODUCED = metrics_mod.default_registry().counter(
     "oryx_topic_produced_total",
@@ -123,8 +126,10 @@ class Broker:
     def num_partitions(self, name: str) -> int:
         raise NotImplementedError
 
-    def append(self, topic: str, key, message) -> None:
-        """Route by key hash to a partition and append (None key round-robins)."""
+    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
+        """Route by key hash to a partition and append (None key round-robins).
+        ``headers`` is transport metadata delivered back on the KeyMessage
+        (trace context rides here, never inside the payload)."""
         raise NotImplementedError
 
     def read(
@@ -253,11 +258,11 @@ class MemoryBroker(Broker):
     def num_partitions(self, name: str) -> int:
         return len(self._topic(name).partitions)
 
-    def append(self, topic: str, key, message) -> None:
+    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
         t = self._topic(topic)
         with t.cond:
             p = partition_for_key(key, len(t.partitions), next(t.rr))
-            t.partitions[p].log.append(KeyMessage(key, message))
+            t.partitions[p].log.append(KeyMessage(key, message, headers))
             t.cond.notify_all()
 
     def read(
@@ -373,13 +378,16 @@ class FileBroker(Broker):
             raise TopicException(f"topic does not exist: {name}")
         return max(1, len(list(d.glob("[0-9]*.jsonl"))))
 
-    def append(self, topic: str, key, message) -> None:
+    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
         n_parts = self.num_partitions(topic)
         part = partition_for_key(key, n_parts, next(self._rr))
         p = self._log_path(topic, part)
         if not p.exists():
             raise TopicException(f"topic does not exist: {topic}")
-        data = (json.dumps({"k": key, "m": message}, separators=(",", ":")) + "\n").encode("utf-8")
+        record = {"k": key, "m": message}
+        if headers:
+            record["h"] = headers
+        data = (json.dumps(record, separators=(",", ":")) + "\n").encode("utf-8")
         fd = os.open(p, os.O_WRONLY | os.O_APPEND)
         try:
             written = os.write(fd, data)
@@ -436,13 +444,9 @@ class FileBroker(Broker):
                 continue
             try:
                 d = json.loads(raw)
-                out.append(KeyMessage(d["k"], d["m"]))
+                out.append(KeyMessage(d["k"], d["m"], d.get("h")))
             except (json.JSONDecodeError, KeyError):
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "skipping corrupt record in topic %s", topic
-                )
+                log.warning("skipping corrupt record in topic %s", topic)
                 out.append(CORRUPT_RECORD)  # keep offsets aligned
         return out[: end - offset]
 
@@ -540,15 +544,19 @@ class TopicProducerImpl:
     def get_topic(self) -> str:
         return self._topic
 
-    def send(self, key, message) -> None:
+    def send(self, key, message, headers: "dict | None" = None) -> None:
         if self._broker is None:
             self._broker = get_broker(self._broker_url)
+        # trace propagation: the producer injects the caller's current span
+        # as a traceparent header (W3C format), so a trace minted at HTTP
+        # ingress crosses the topic hop into whichever tier consumes this
+        headers = spans.inject_headers(headers)
         try:
             if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
                 raise TopicException(
                     f"message of {len(message)} bytes exceeds max {self._max_size}"
                 )
-            self._broker.append(self._topic, key, message)
+            self._broker.append(self._topic, key, message, headers)
         except Exception:
             _SEND_FAILURES.labels(self._topic).inc()
             raise

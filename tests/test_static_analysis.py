@@ -399,6 +399,51 @@ def test_float64_quiet_on_f32_and_host_code():
 
 
 # ---------------------------------------------------------------------------
+# log-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_log_discipline_fires_on_print_and_bare_getlogger_in_hot_paths():
+    src = """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def handler():
+            print("served one request")
+    """
+    hits = _run(src, "log-discipline",
+                filename="oryx_tpu/serving/fixture.py")
+    kinds = {f.symbol.split(":")[0] for f in hits}
+    assert kinds == {"getLogger", "print"}
+    assert any("spans.get_logger" in f.message for f in hits)
+
+
+def test_log_discipline_quiet_outside_hot_paths_and_on_adapter():
+    src = """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def cli():
+            print("benches print by design")
+    """
+    # same source is fine outside the serving/transport/lambda_rt tiers
+    assert _run(src, "log-discipline",
+                filename="oryx_tpu/tools/fixture.py") == []
+    clean = """
+        from oryx_tpu.common import spans
+
+        log = spans.get_logger(__name__)
+
+        def handler():
+            log.warning("structured, trace-correlated")
+    """
+    assert _run(clean, "log-discipline",
+                filename="oryx_tpu/transport/fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
