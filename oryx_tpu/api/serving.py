@@ -53,6 +53,21 @@ class ServingModelManager(abc.ABC):
     def get_model(self) -> ServingModel | None:
         ...
 
+    def get_staged_model(self) -> ServingModel | None:
+        """The incoming model generation being double-buffered for a
+        prewarmed swap, if any. Managers that swap in place return None;
+        the serving batch warmer warms whatever this returns FIRST, then
+        calls :meth:`promote_staged` to flip it into service."""
+        return None
+
+    def promote_staged(self, expected=None) -> bool:
+        """Atomically promote the staged generation into service after its
+        off-path warmup completed. ``expected`` (when given) must still BE
+        the staged model — a later push may have replaced it mid-warm, and
+        flipping an unwarmed replacement would defeat the prewarm. Returns
+        True when a flip happened."""
+        return False
+
     def is_read_only(self) -> bool:
         cfg = self.get_config()
         return bool(cfg and cfg.get_bool("oryx.serving.api.read-only", False))

@@ -142,6 +142,38 @@ oryx = {
     process-id = null
   }
 
+  # Compile-lifecycle subsystem (common/compilecache.py): persistent XLA
+  # compilation cache + serving bucket warmup. Removes steady-state compiles
+  # from the request path (docs/performance.md "Compile lifecycle").
+  compile = {
+    # Directory for jax's persistent compilation cache. Restarted processes
+    # and horizontal serving replicas sharing it deserialize XLA binaries
+    # instead of recompiling. null disables. Same shared-filesystem caveat
+    # as the file: broker (docs/admin.md): local disk or a real shared FS;
+    # the cache tolerates concurrent writers (content-keyed entries).
+    cache-dir = null
+    # Only cache compiled binaries at least this large (bytes). 0 caches
+    # everything — the serving tier wants EVERY bucket binary on disk.
+    min-entry-size-bytes = 0
+    # Only cache compiles that took at least this long. jax's own default
+    # (1s) would skip most bucket programs; 0 caches all of them.
+    min-compile-time-sec = 0
+    # GET /readyz gate with precompile-batches on: fraction of the pow2
+    # bucket ladder that must be compiled before the replica reports ready.
+    # 1.0 = fully warm; lower values trade cold-start latency risk for
+    # earlier traffic.
+    ready-warm-fraction = 1.0
+    # Double-buffer model-generation handoffs: build + warm the incoming
+    # generation off-path and atomically flip, so a MODEL push never causes
+    # a request-visible compile storm. Effective only with
+    # precompile-batches on (something must run the warmup ladder).
+    prewarm-swap = true
+    # Upper bound on how long a staged generation may wait for its warmup
+    # before being promoted anyway (warmer died, warm keeps failing). 0
+    # disables the valve.
+    swap-deadline-sec = 120
+  }
+
   # Framework-wide metrics registry + Prometheus text exposition on
   # GET /metrics (replaces the reference's Spark-UI/JMX metrics story;
   # docs/observability.md has the catalog).

@@ -15,6 +15,7 @@ from typing import Callable, Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import classutils
+from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
@@ -35,6 +36,10 @@ class AbstractLayer:
         self.tier = tier
         metrics_mod.configure(config)  # batch/speed never build an HTTP app
         spans.configure(config)
+        # batch/speed tiers recompile their training programs on every
+        # process restart; the shared persistent compilation cache (and the
+        # compile counter) applies to them exactly as to serving replicas
+        compilecache.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")

@@ -32,6 +32,7 @@ import numpy as np
 from oryx_tpu.api.serving import ServingModel
 from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
 from oryx_tpu.api.serving import AbstractServingModelManager
+from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import spans
 from oryx_tpu.models.als import pmml_codec
@@ -54,6 +55,14 @@ _TOPN_QUERIES = metrics_mod.default_registry().counter(
 _LOAD_FRACTION = metrics_mod.default_registry().gauge(
     "oryx_serving_model_load_fraction",
     "Fraction of expected model vectors loaded (evaluated at scrape time)",
+)
+_PREWARMED_SWAPS = metrics_mod.default_registry().counter(
+    "oryx_serving_prewarmed_swaps_total",
+    "Model-generation swaps promoted after off-path bucket warmup",
+)
+_DEADLINE_SWAPS = metrics_mod.default_registry().counter(
+    "oryx_serving_swap_deadline_promotions_total",
+    "Staged model generations promoted by the swap deadline, unwarmed",
 )
 
 
@@ -631,6 +640,49 @@ class ALSServingModel(ServingModel):
             out.append(got)
         return out
 
+    def warm_bucket(self, batch_size: int, how_many: int = 10) -> None:
+        """Pre-compile the batched top-N program for ONE pow2 batch size
+        against the live factor shapes — the per-bucket unit of the serving
+        warmup ladder (serving/app.py _BatchWarmer, smallest bucket first).
+
+        Two steps: an AOT ``jitted.lower(shapes).compile()`` via
+        :func:`compilecache.aot_compile` (seeds the in-process lowering
+        cache AND, when ``oryx.compile.cache-dir`` is set, the persistent
+        cache — so restarts and sibling replicas skip the XLA compile
+        entirely), then one real zero-batch execution to populate the jit
+        dispatch cache the request path actually hits and to materialize
+        the device-resident factor snapshot. Raises when the model has no
+        items yet (the warmer retries later)."""
+        import jax
+
+        snap = self.y_snapshot()
+        if snap.mat is None or snap.n == 0:
+            raise ValueError("no item factors to warm against yet")
+        qs_struct = jax.ShapeDtypeStruct(
+            (batch_size, self.features), jnp.float32
+        )
+        if snap.sharded_mat is not None:
+            # the sharded scan builds its program through the lru-cached
+            # _sharded_top_k_fn; the execution below compiles it off-path
+            pass
+        elif self.lsh is None or snap.buckets is None:
+            k = min(snap.n, _round_up_pow2(max(how_many, 16)))
+            compilecache.aot_compile(
+                _top_k_dot_batch, snap.score_mat, qs_struct, None, None, k
+            )
+        else:
+            k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
+            lut_struct = jax.ShapeDtypeStruct(
+                (batch_size, self.lsh.num_buckets), jnp.bool_
+            )
+            compilecache.aot_compile(
+                _top_k_dot_batch_masked, snap.score_mat, qs_struct,
+                lut_struct, snap.buckets, None, k
+            )
+        self.top_n_batch(
+            np.zeros((batch_size, self.features), dtype=np.float32), how_many
+        )
+
     def top_n_cosine(
         self,
         query_vecs: np.ndarray,
@@ -730,6 +782,22 @@ class ALSServingModelManager(AbstractServingModelManager):
         # reference's test-and-trigger
         self._solver_trigger_rate = RateLimitCheck(5)
         self.model: ALSServingModel | None = None
+        # double-buffered generation handoff: with the batch warmer running,
+        # a MODEL push with new array shapes builds the incoming generation
+        # here while the warm old generation keeps answering queries; the
+        # warmer precompiles the staged model's buckets off-path and then
+        # promotes it atomically — an update-topic model push never causes a
+        # request-visible compile storm
+        self._staged: ALSServingModel | None = None
+        self._staged_at = 0.0
+        self._swap_lock = threading.Lock()
+        self._prewarm_swap = (
+            config.get_bool("oryx.serving.compute.precompile-batches", False)
+            and config.get_bool("oryx.compile.prewarm-swap", True)
+        )
+        self._swap_deadline = config.get_float(
+            "oryx.compile.swap-deadline-sec", 120.0
+        )
         _LOAD_FRACTION.set_function(_load_fraction_fn(weakref.ref(self)))
         self.rescorer_provider = load_rescorer_providers(config)
         self.mesh = None
@@ -743,20 +811,64 @@ class ALSServingModelManager(AbstractServingModelManager):
                 log.info("sharded serving requested but only one device")
 
     def get_model(self) -> "ALSServingModel | None":
-        return self.model
+        # deadline valve on the request path: one None-check when no swap is
+        # staged; a staged generation whose warmer died (or whose warm keeps
+        # failing) must still land eventually rather than strand the push.
+        # Lock-free reads: single reference loads are atomic under the GIL
+        # and a stale value is benign (the old generation stays valid until
+        # the flip, which happens under _swap_lock and re-checks there)
+        staged = self._staged  # analyze: ignore[lock-discipline] -- atomic reference load on the hot path; flip is under _swap_lock
+        if staged is not None and self._swap_deadline > 0 and (
+            time.monotonic() - self._staged_at > self._swap_deadline  # analyze: ignore[lock-discipline] -- _staged_at is written before _staged publishes, so a visible staged model always pairs with its own timestamp
+        ):
+            if self._promote_staged(expected=staged, deadline=True):
+                log.warning(
+                    "promoting staged model generation unwarmed: swap "
+                    "deadline (%.0fs) passed", self._swap_deadline,
+                )
+        return self.model  # analyze: ignore[lock-discipline] -- atomic reference load on the hot path; flip is under _swap_lock
+
+    def get_staged_model(self) -> "ALSServingModel | None":
+        with self._swap_lock:
+            return self._staged
+
+    def promote_staged(self, expected=None) -> bool:
+        """Atomically flip the warmed staged generation into service
+        (called by the batch warmer after its bucket ladder completes).
+        ``expected`` guards against promoting a model the caller did not
+        warm: if a later MODEL push replaced the staged generation while
+        the ladder ran, the flip is refused and the warmer re-runs."""
+        return self._promote_staged(expected=expected, deadline=False)
+
+    def _promote_staged(self, expected, deadline: bool) -> bool:
+        with self._swap_lock:
+            staged = self._staged
+            if staged is None or (expected is not None and staged is not expected):
+                return False
+            self.model = staged
+            self._staged = None
+        (_DEADLINE_SWAPS if deadline else _PREWARMED_SWAPS).inc()
+        return True
+
+    def _current_generation(self) -> "ALSServingModel | None":
+        """The generation the update topic is describing NOW: the staged
+        model once a MODEL handoff is in flight, else the serving one."""
+        with self._swap_lock:
+            return self._staged or self.model
 
     def consume_key_message(self, key: str, message: str) -> None:
         if key == "UP":
-            if self.model is None:
+            model = self._current_generation()
+            if model is None:
                 return
             update = json.loads(message)
             kind, id_, vec = update[0], update[1], np.asarray(update[2], dtype=np.float32)
             if kind == "X":
-                self.model.set_user_vector(id_, vec)
+                model.set_user_vector(id_, vec)
                 if len(update) > 3:
-                    self.model.add_known_items(id_, update[3])
+                    model.add_known_items(id_, update[3])
             elif kind == "Y":
-                self.model.set_item_vector(id_, vec)
+                model.set_item_vector(id_, vec)
             else:
                 raise ValueError(f"bad update type: {kind}")
             self._maybe_trigger_solvers()
@@ -764,15 +876,32 @@ class ALSServingModelManager(AbstractServingModelManager):
             pmml = read_pmml_from_update_key_message(key, message)
             meta = pmml_codec.pmml_to_meta(pmml)
             features = meta["features"]
-            if self.model is None or self.model.features != features:
-                log.info("new serving model (features=%d)", features)
-                self.model = ALSServingModel(
+            current = self._current_generation()
+            if current is None or current.features != features:
+                new_model = ALSServingModel(
                     features, meta["implicit"], self.sample_rate, mesh=self.mesh
                 )
-                self.model.expected_user_ids = set(meta["x_ids"])
-                self.model.expected_item_ids = set(meta["y_ids"])
+                new_model.expected_user_ids = set(meta["x_ids"])
+                new_model.expected_item_ids = set(meta["y_ids"])
+                with self._swap_lock:
+                    if self.model is not None and self._prewarm_swap:
+                        # double-buffer: keep serving the old generation; the
+                        # warmer fills/warms this one off-path, then promotes.
+                        # Timestamp BEFORE publishing the reference: the
+                        # deadline valve reads both lock-free, and the old
+                        # order let it pair a fresh staged model with a
+                        # stale timestamp and promote it cold on the spot
+                        staging = True
+                        self._staged_at = time.monotonic()
+                        self._staged = new_model
+                    else:
+                        staging = False
+                        self.model = new_model
+                        self._staged = None
+                log.info("%s serving model generation (features=%d)",
+                         "staging" if staging else "new", features)
             else:
-                m = self.model
+                m = current
                 m.retain_recent_and_user_ids(meta["x_ids"])
                 m.retain_recent_and_item_ids(meta["y_ids"])
                 m.retain_recent_and_known_items(meta["x_ids"])
@@ -789,7 +918,11 @@ class ALSServingModelManager(AbstractServingModelManager):
         walks the expected-ID sets, too costly per UP message; the launch
         itself is a no-op when the cache is clean (single-flight dirty flag),
         so later UPs re-warm naturally."""
-        if self.model is None or not self._solver_trigger_rate.test():
+        # the CURRENT generation: during a staged swap the UPs are filling
+        # the staged model, and promoting it with a cold YtY solver would
+        # stall the first post-flip fold-in on the synchronous factorization
+        model = self._current_generation()
+        if model is None or not self._solver_trigger_rate.test():
             return
-        if self.model.get_fraction_loaded() >= self.min_model_load_fraction:
-            self.model.precompute_solvers()
+        if model.get_fraction_loaded() >= self.min_model_load_fraction:
+            model.precompute_solvers()

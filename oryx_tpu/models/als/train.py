@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from dataclasses import dataclass
 
 import jax
@@ -97,6 +98,37 @@ class _BlockedSide:
         return self.n_blocks * self.block
 
 
+def _pack_workers(workers: "int | None", nnz: int) -> int:
+    """Worker count for the host-side pack scatters: explicit wins; small
+    packs stay serial (thread fan-out costs more than it saves below ~2M
+    entries); big packs use up to 8 host cores."""
+    if workers is not None:
+        return max(1, workers)
+    if nnz < 2_000_000:
+        return 1
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def _chunked_scatter(fn, n: int, workers: int, chunk: int = 1_000_000) -> None:
+    """Run ``fn(lo, hi)`` over [0, n) — serially, or chunked across a thread
+    pool. Callers guarantee every (lo, hi) slice writes DISJOINT output
+    cells, so chunk boundaries need no coordination; numpy's fancy-index
+    assignment releases the GIL for flat dtypes, which is what makes the
+    threads actually overlap."""
+    if workers <= 1 or n <= chunk:
+        fn(0, n)
+        return
+    import concurrent.futures as cf
+
+    step = max(chunk, -(-n // (workers * 4)))  # ~4 chunks per worker
+    with cf.ThreadPoolExecutor(workers) as pool:
+        futs = [
+            pool.submit(fn, lo, min(n, lo + step)) for lo in range(0, n, step)
+        ]
+        for f in futs:
+            f.result()
+
+
 def make_blocked_side(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -107,13 +139,17 @@ def make_blocked_side(
     slot_width: int | None,
     n_block_multiple: int = 1,
     features: int | None = None,
+    workers: int | None = None,
 ) -> _BlockedSide:
     """Host-side slotted-COO construction (row-sorted → contiguous slots).
 
     ``slot_width=None`` picks T from the side's mean row degree (one degree
     histogram, reused for the slot layout); ``slot_chunk=None`` then sizes
     the scan chunk from T and ``features`` to stay inside the transient
-    budget."""
+    budget. Entries scatter STRAIGHT into the preallocated (n_blocks, S, T)
+    output slabs — no intermediate flat slot arrays — and the scatter is
+    chunked over a thread pool (``workers``; every entry owns a distinct
+    cell, so chunks are embarrassingly parallel)."""
     # sort by (row, col): row-major for contiguous slots, column-ascending
     # within each row so the per-slot gathers of the opposite factors walk
     # HBM in address order instead of randomly. One stable argsort on a
@@ -131,6 +167,7 @@ def make_blocked_side(
     n_blocks = max(1, -(-n_rows // block))
     n_blocks = -(-n_blocks // n_block_multiple) * n_block_multiple
     padded_rows = n_blocks * block
+    n_workers = _pack_workers(workers, len(r))
 
     deg = np.bincount(r, minlength=padded_rows) if len(r) else np.zeros(
         padded_rows, dtype=np.int64
@@ -151,21 +188,10 @@ def make_blocked_side(
     np.cumsum(deg, out=row_entry_start[1:])
     total_slots = int(row_slot_start[-1])
 
-    scols_f = np.zeros((total_slots, t), dtype=np.int32)
-    svals_f = np.zeros((total_slots, t), dtype=np.float32)
-    if len(r):
-        p = np.arange(len(r), dtype=np.int64) - row_entry_start[r]
-        slot = row_slot_start[r] + p // t
-        pos = p % t
-        scols_f[slot, pos] = c
-        svals_f[slot, pos] = v
-        slens_f = np.bincount(slot, minlength=total_slots).astype(np.int32)
-    else:
-        slens_f = np.zeros(total_slots, dtype=np.int32)
-    srow_f = np.repeat(np.arange(padded_rows, dtype=np.int64), nslots_row)
-
-    sblock = srow_f // block
-    bounds = np.searchsorted(sblock, np.arange(n_blocks + 1, dtype=np.int64))
+    # slots are row-ordered, so block b's slots are exactly the run
+    # row_slot_start[b*block : (b+1)*block] — per-block extents come
+    # straight off the cumsum, no searchsorted
+    bounds = row_slot_start[::block]  # (n_blocks + 1,)
     max_s = int(np.diff(bounds).max()) if total_slots else 0
     # fewest scan steps that fit the transient budget, with the chunk sized
     # to divide S exactly: sequential chunk steps are the TPU's enemy, and a
@@ -193,11 +219,40 @@ def make_blocked_side(
     svals = np.zeros((n_blocks, s_len, t), dtype=np.float32)
     slens = np.zeros((n_blocks, s_len), dtype=np.int32)
     if total_slots:
-        sidx = np.arange(total_slots, dtype=np.int64) - bounds[sblock]
-        srows[sblock, sidx] = (srow_f - sblock * block).astype(np.int32)
-        scols[sblock, sidx] = scols_f
-        svals[sblock, sidx] = svals_f
-        slens[sblock, sidx] = slens_f
+        # per-slot coordinates: owning row, block, and index within block
+        srow_f = np.repeat(np.arange(padded_rows, dtype=np.int64), nslots_row)
+        sb = (srow_f // block).astype(np.int32)
+        sidx = (np.arange(total_slots, dtype=np.int64) - bounds[sb]).astype(np.int32)
+        # valid entries per slot straight from the degree histogram: a row's
+        # slots carry T, T, ..., remainder — no per-entry bincount needed
+        slot_in_row = np.arange(total_slots, dtype=np.int64) - row_slot_start[srow_f]
+        srows[sb, sidx] = (srow_f % block).astype(np.int32)
+        slens[sb, sidx] = np.minimum(
+            deg[srow_f] - slot_in_row * t, t
+        ).astype(np.int32)
+        del slot_in_row
+        if len(r):
+            # per-entry final coordinates — each entry owns one distinct
+            # (block, slot, pos) cell in the preallocated slabs, so the
+            # scatter chunks cleanly across the worker pool. Index dtypes
+            # are downcast and intermediates freed eagerly: at 10M nnz the
+            # int64 versions alone would add hundreds of MB of transient,
+            # and the reference-scale memory bound (test_als_scale) holds
+            # the whole train under a hard rlimit
+            p = np.arange(len(r), dtype=np.int64) - row_entry_start[r]
+            slot = row_slot_start[r] + p // t
+            pos = (p % t).astype(np.int32)
+            del p
+            eb = (r // block).astype(np.int32)
+            es = (slot - bounds[eb]).astype(np.int32)
+            del slot
+
+            def scatter(lo: int, hi: int) -> None:
+                scols[eb[lo:hi], es[lo:hi], pos[lo:hi]] = c[lo:hi]
+                svals[eb[lo:hi], es[lo:hi], pos[lo:hi]] = v[lo:hi]
+
+            _chunked_scatter(scatter, len(r), n_workers)
+            del eb, es, pos
     return _BlockedSide(
         jnp.asarray(srows), jnp.asarray(scols), jnp.asarray(svals),
         jnp.asarray(slens), n_rows, block, n_blocks, t, slot_chunk,
@@ -396,12 +451,16 @@ def prepare_blocked(
     block: int | None = None,
     chunk: int | None = None,
     slot_width: int | None = None,
+    workers: int | None = None,
 ) -> tuple[_BlockedSide, _BlockedSide]:
     """Pack both half-iteration sides with production block/chunk sizing.
 
     The single setup path shared by :func:`als_train` and the training
     benchmark, so published throughput always measures the same layout
-    production uses."""
+    production uses. The two sides pack CONCURRENTLY on big inputs (the
+    dominant costs — the fused-key argsort, gathers, bincounts, and the
+    slab scatters — all release the GIL), on top of each side's own
+    chunked scatter pool; ``workers`` caps both (None = auto, 1 = serial)."""
     n_users, n_items = len(batch.users), len(batch.items)
     auto = _auto_block(features) if block is None else block
 
@@ -416,15 +475,26 @@ def prepare_blocked(
 
     block_u = even_block(n_users)
     block_i = even_block(n_items)
-    user_side = make_blocked_side(
-        batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
-        slot_width, ndev, features=features,
-    )
-    item_side = make_blocked_side(
-        batch.cols, batch.rows, batch.vals, n_items, block_i, chunk,
-        slot_width, ndev, features=features,
-    )
-    return user_side, item_side
+
+    def pack_user() -> _BlockedSide:
+        return make_blocked_side(
+            batch.rows, batch.cols, batch.vals, n_users, block_u, chunk,
+            slot_width, ndev, features=features, workers=workers,
+        )
+
+    def pack_item() -> _BlockedSide:
+        return make_blocked_side(
+            batch.cols, batch.rows, batch.vals, n_items, block_i, chunk,
+            slot_width, ndev, features=features, workers=workers,
+        )
+
+    if _pack_workers(workers, len(batch.rows)) > 1:
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as pool:
+            fu, fi = pool.submit(pack_user), pool.submit(pack_item)
+            return fu.result(), fi.result()
+    return pack_user(), pack_item()
 
 
 def init_item_factors(item_side: _BlockedSide, n_items: int, features: int,

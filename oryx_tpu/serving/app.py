@@ -27,6 +27,7 @@ from aiohttp import web
 
 from oryx_tpu.api.serving import ServingModelManager
 from oryx_tpu.common import classutils
+from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
@@ -246,6 +247,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     (OryxApplication.java:54-96)."""
     metrics_mod.configure(config)
     spans.configure(config)
+    compilecache.configure(config)
     middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
     auth_mw = _auth_middleware(config)
     if auth_mw is not None:
@@ -465,11 +467,21 @@ class _BatchWarmer(threading.Thread):
     on a TPU each signature's FIRST occurrence still pays an XLA compile
     (seconds), which otherwise lands inside the first client burst after
     every MODEL handoff. When ``oryx.serving.compute.precompile-batches``
-    is on, this thread watches for a new ready model and runs a zero-vector
-    ladder of pow2 batch sizes (largest first — the steady-state size under
-    load) through ``top_n_batch``, populating the very jit caches real
-    queries will hit. Models without a batched top-N (k-means, RDF) are
-    skipped; exclusion-carrying signatures still compile on first use."""
+    is on, this thread watches for a new ready model and walks the shared
+    pow2 bucket ladder (``batcher.pow2_buckets``, SMALLEST first so the
+    replica turns ready incrementally and the warm-fraction readiness gate
+    can trip early) through each model's ``warm_bucket`` hook — AOT
+    ``lower().compile()`` plus one real execution — populating the very jit
+    caches real queries hit. Progress feeds ``compilecache.warmup_state()``
+    (readyz gating + the oryx_warmup_* metrics) and each ladder is traced
+    as a ``serving.warmup`` span with per-bucket children.
+
+    Generation handoffs double-buffer through the manager's STAGED model:
+    the warmer warms a staged generation before the serving one, then
+    promotes it atomically, so an update-topic model push never causes a
+    request-visible compile storm. Models without a batched top-N (k-means,
+    RDF) mark warmup trivially complete; exclusion-carrying signatures
+    still compile on first use."""
 
     # the reference API's default howMany — warms the top-k width the
     # common request hits; larger howMany values still compile on first use
@@ -480,22 +492,22 @@ class _BatchWarmer(threading.Thread):
         super().__init__(name="OryxServingBatchWarmer", daemon=True)
         self.manager = manager
         self.min_fraction = min_fraction
-        # the coalescer's own floor: warming a size real flushes never
-        # produce would waste the biggest compile
-        from oryx_tpu.serving.batcher import floor_pow2
+        # the shared bucket enumeration: warming a size real flushes never
+        # produce would waste the biggest compile, and a flushed size that
+        # was never warmed would compile on-path — one list rules both
+        from oryx_tpu.serving.batcher import pow2_buckets
 
-        self.max_batch = floor_pow2(max_batch)
+        self.buckets = pow2_buckets(max_batch)  # ascending: smallest first
         # NOT named _stop: threading.Thread.join() calls an internal
         # self._stop() when the thread finishes, and an Event attribute of
         # that name shadows it (TypeError on the first join)
         self._stop_event = stop_event
         self.warmed_models: int = 0  # observability + tests
+        self.promoted_models: int = 0
 
     def run(self) -> None:
         import time as _time
         import weakref
-
-        import numpy as np
 
         # weakref: a strong reference here would pin a RETIRED model
         # generation (hundreds of MB of factors) for as long as its
@@ -504,13 +516,19 @@ class _BatchWarmer(threading.Thread):
         not_before = 0.0  # fraction walks are costly: back off between tries
         failures = 0
         while not self._stop_event.wait(0.25):
-            model = self.manager.get_model()
-            if (
-                model is None
-                or (last_warmed is not None and last_warmed() is model)
-                or not hasattr(model, "top_n_batch")
-                or not hasattr(model, "features")
+            # a staged (incoming) generation warms FIRST: the serving model
+            # is warm already, and the staged one blocks a pending swap
+            staged = self.manager.get_staged_model()
+            model = staged if staged is not None else self.manager.get_model()
+            if model is None or (
+                last_warmed is not None and last_warmed() is model
             ):
+                continue
+            if not hasattr(model, "top_n_batch") or not hasattr(model, "features"):
+                # nothing batched to warm on this app family — readiness
+                # must not wait on a ladder that will never run
+                compilecache.warmup_state().mark_trivial()
+                last_warmed = weakref.ref(model)
                 continue
             now = _time.monotonic()
             if now < not_before:
@@ -520,30 +538,66 @@ class _BatchWarmer(threading.Thread):
                 # _maybe_trigger_solvers' rate limit) — don't hammer it
                 not_before = now + 2.0
                 continue
-            ok = True
-            b = self.max_batch
-            while b >= 1:
-                if self._stop_event.is_set():
-                    return
-                try:
-                    model.top_n_batch(
-                        np.zeros((b, model.features), dtype=np.float32),
-                        self.WARM_HOW_MANY,
-                    )
-                except Exception:  # noqa: BLE001 — e.g. no items yet
-                    log.debug("batch warm at size %d failed", b, exc_info=True)
-                    ok = False
-                    break
-                b //= 2
-            if ok:
+            if self._warm_model(model):
                 last_warmed = weakref.ref(model)
                 self.warmed_models += 1
                 failures = 0
+                # expected= guards the flip: a newer MODEL push may have
+                # replaced the staged generation while this ladder ran, and
+                # that replacement is unwarmed — leave it for the next pass
+                if staged is not None and self.manager.promote_staged(
+                    expected=model
+                ):
+                    self.promoted_models += 1
+                    log.info("promoted prewarmed model generation")
             else:
                 # retry the SAME model later: items may simply not have
                 # arrived yet, and a silent skip would strand the feature
                 failures += 1
                 not_before = _time.monotonic() + min(10.0, 2.0 * failures)
+
+    def _warm_model(self, model) -> bool:
+        """One bucket ladder, smallest first; progress into the shared
+        warmup state so /readyz (warm-fraction gate) tracks it live."""
+        import time as _time
+
+        import numpy as np
+
+        state = compilecache.warmup_state()
+        state.begin(len(self.buckets))
+        t_model = _time.perf_counter()
+        with spans.span(
+            "serving.warmup", parent=None,
+            attributes={"route": "serving.warmup",
+                        "buckets": len(self.buckets)},
+        ):
+            for b in self.buckets:
+                if self._stop_event.is_set():
+                    return False
+                t0 = _time.perf_counter()
+                try:
+                    with spans.span(
+                        "serving.warmup.bucket",
+                        attributes={"route": "serving.warmup",
+                                    "batch.size": b},
+                    ):
+                        if hasattr(model, "warm_bucket"):
+                            model.warm_bucket(b, self.WARM_HOW_MANY)
+                        else:
+                            model.top_n_batch(
+                                np.zeros((b, model.features), dtype=np.float32),
+                                self.WARM_HOW_MANY,
+                            )
+                except Exception:  # noqa: BLE001 — e.g. no items yet
+                    log.debug("batch warm at size %d failed", b, exc_info=True)
+                    return False
+                compilecache.observe_warmup(
+                    "bucket", _time.perf_counter() - t0
+                )
+                state.bucket_done()
+        compilecache.observe_warmup("model", _time.perf_counter() - t_model)
+        state.finish()
+        return True
 
 
 class ServingLayer:
@@ -575,6 +629,9 @@ class ServingLayer:
         self._failure: BaseException | None = None
 
     def start(self) -> None:
+        # cache + compile accounting first: the persistent compilation cache
+        # must be live before the FIRST model compile of this process
+        compilecache.configure(self.config)
         # topics must exist (ModelManagerListener.contextInitialized:107-127)
         if not self.config.get_bool("oryx.serving.no-init-topics", False):
             for burl, bt in ((self.input_broker, self.input_topic),
@@ -608,9 +665,15 @@ class ServingLayer:
         )
         self._consumer_thread.start()
 
+        # this layer owns the process's serving warmup state: reset leftovers
+        # from a previous layer in the same process, then arm when warmup is
+        # configured so /readyz holds until the first ladder completes
+        warm_state = compilecache.warmup_state()
+        warm_state.reset()
         if self.config.get_bool(
             "oryx.serving.compute.precompile-batches", False
         ):
+            warm_state.arm()
             self._warmer = _BatchWarmer(
                 self.manager,
                 self.config.get_float("oryx.serving.min-model-load-fraction"),

@@ -55,6 +55,18 @@ def floor_pow2(n: int) -> int:
     return 1 << max(0, max(1, n).bit_length() - 1)
 
 
+def pow2_buckets(max_batch: int) -> list[int]:
+    """Ascending pow2 batch buckets ``[1, 2, ..., floor_pow2(max_batch)]``.
+
+    THE bucket enumeration of the serving hot path: the coalescer pads every
+    flush up to one of these sizes (``_execute``), and the warmup subsystem
+    precompiles exactly this ladder (smallest first, so a starting replica
+    turns ready incrementally) — keeping both ends in one function means a
+    cap change can never warm sizes that are not flushed, or flush sizes
+    that were not warmed."""
+    return [1 << i for i in range(floor_pow2(max_batch).bit_length())]
+
+
 class _Pending:
     __slots__ = ("vec", "want", "how_many", "offset", "allowed", "excluded",
                  "future", "enq_t", "wait_span")

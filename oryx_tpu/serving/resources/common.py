@@ -16,6 +16,7 @@ from __future__ import annotations
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
@@ -54,7 +55,15 @@ async def readyz(request: web.Request) -> web.Response:
     quiet, while a wedged consumer with a backlog keeps serving the OLD
     model silently, and this gate lets the balancer rotate that replica
     out before users notice. Both gauges are scrape-time callbacks, so the
-    probe works even with ``oryx.metrics.enabled = false``."""
+    probe works even with ``oryx.metrics.enabled = false``.
+
+    With batch-bucket warmup configured (``precompile-batches``), a third
+    condition gates readiness: at least ``oryx.compile.ready-warm-fraction``
+    of the pow2 bucket ladder must be compiled (default 1.0), so load
+    balancers never route into a replica that would answer its first burst
+    with XLA compiles. The ``warmup`` detail reports {done, total} buckets;
+    once one ladder fully completes, warm-readiness is sticky — a staged
+    generation re-warming off-path must not drop the replica out."""
     detail: dict = {}
     ok = True
     try:
@@ -64,6 +73,12 @@ async def readyz(request: web.Request) -> web.Response:
         detail["model"] = "not loaded"
         ok = False
     config = request.app[rsrc.CONFIG_KEY]
+    warm = compilecache.warmup_state()
+    detail["warmup"] = warm.snapshot()
+    warm_fraction = config.get_float("oryx.compile.ready-warm-fraction", 1.0)
+    if not warm.ready(warm_fraction):
+        detail["warmup_status"] = "cold"
+        ok = False
     max_lag = config.get_float("oryx.serving.ready-max-lag-sec", 600.0)
     detail["ready_max_lag_sec"] = max_lag
     if max_lag > 0:

@@ -3,6 +3,7 @@
 from oryx_tpu.tools.analyze.checkers.recompile import JitRecompileChecker
 from oryx_tpu.tools.analyze.checkers.tracer import TracerLeakChecker
 from oryx_tpu.tools.analyze.checkers.blocking import BlockingAsyncChecker
+from oryx_tpu.tools.analyze.checkers.hotcompile import HotPathCompileChecker
 from oryx_tpu.tools.analyze.checkers.locks import LockDisciplineChecker
 from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
 from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
@@ -12,6 +13,7 @@ ALL_CHECKERS = (
     JitRecompileChecker(),
     TracerLeakChecker(),
     BlockingAsyncChecker(),
+    HotPathCompileChecker(),
     LockDisciplineChecker(),
     ConfigKeyDriftChecker(),
     Float64PromotionChecker(),

@@ -25,7 +25,12 @@ from __future__ import annotations
 
 import ast
 
-from oryx_tpu.tools.analyze.core import walk_scope
+from oryx_tpu.tools.analyze.core import (
+    call_edges,
+    method_classes,
+    module_map,
+    walk_scope,
+)
 
 ID = "blocking-async"
 
@@ -68,39 +73,25 @@ def _identifiers(node: ast.AST) -> list:
             return out
 
 
-def _module_name(relpath: str) -> str:
-    mod = relpath[:-3] if relpath.endswith(".py") else relpath
-    mod = mod.replace("/", ".")
-    if mod.endswith(".__init__"):
-        mod = mod[: -len(".__init__")]
-    return mod
-
-
 class BlockingAsyncChecker:
     id = ID
 
     def check(self, project) -> list:
         # -- pass 1: per-function direct blocking facts + call edges --------
-        module_of = {}  # module dotted name -> fctx
-        for fctx in project.files:
-            module_of[_module_name(fctx.relpath)] = fctx
+        module_of = module_map(project)
 
         facts = {}  # (relpath, qualname) -> (line, cause) | None
         edges = {}  # (relpath, qualname) -> list[(call_line, callee_key, label)]
-        fn_class = {}  # fn node -> class node (immediate methods only)
         async_keys = set()
 
         for fctx in project.files:
-            for _, cnode in fctx.classes:
-                for child in cnode.body:
-                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        fn_class[child] = cnode
+            fn_class = method_classes(fctx)
             for qual, fn in fctx.functions:
                 key = (fctx.relpath, qual)
                 if isinstance(fn, ast.AsyncFunctionDef):
                     async_keys.add(key)
                 facts[key] = self._direct_fact(fctx, fn)
-                edges[key] = self._edges(fctx, fn, fn_class, module_of)
+                edges[key] = call_edges(fctx, fn, fn_class, module_of)
 
         # -- pass 2: propagate blocking through the call graph --------------
         blocking = {k: v for k, v in facts.items() if v is not None}
@@ -188,51 +179,3 @@ class BlockingAsyncChecker:
                         "file I/O under the broker lock on file: brokers",
                     )
         return None
-
-    def _edges(self, fctx, fn, fn_class, module_of) -> list:
-        out = []
-        for node in walk_scope(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if isinstance(func, ast.Name):
-                # local function, or from-import of a project function
-                local = fctx.functions_by_name.get(func.id)
-                if local:
-                    target = min(local, key=lambda n: fctx.qualname_of[n].count("."))
-                    out.append((node.lineno, (fctx.relpath, fctx.qualname_of[target]),
-                                f"`{func.id}()`"))
-                    continue
-                origin = fctx.import_map.get(func.id)
-                if origin and "." in origin:
-                    mod, _, name = origin.rpartition(".")
-                    target_fctx = module_of.get(mod)
-                    if target_fctx is not None and name in target_fctx.functions_by_name:
-                        t = target_fctx.functions_by_name[name][0]
-                        out.append((node.lineno,
-                                    (target_fctx.relpath, target_fctx.qualname_of[t]),
-                                    f"`{func.id}()`"))
-            elif isinstance(func, ast.Attribute):
-                if isinstance(func.value, ast.Name) and func.value.id == "self":
-                    cnode = fn_class.get(fn)
-                    if cnode is not None:
-                        for child in cnode.body:
-                            if (
-                                isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
-                                and child.name == func.attr
-                            ):
-                                out.append((node.lineno,
-                                            (fctx.relpath, fctx.qualname_of[child]),
-                                            f"`self.{func.attr}()`"))
-                                break
-                    continue
-                resolved = fctx.resolve(func)
-                if resolved and "." in resolved:
-                    mod, _, name = resolved.rpartition(".")
-                    target_fctx = module_of.get(mod)
-                    if target_fctx is not None and name in target_fctx.functions_by_name:
-                        t = target_fctx.functions_by_name[name][0]
-                        out.append((node.lineno,
-                                    (target_fctx.relpath, target_fctx.qualname_of[t]),
-                                    f"`{ast.unparse(func)}()`"))
-        return out

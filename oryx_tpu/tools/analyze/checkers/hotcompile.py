@@ -1,0 +1,134 @@
+"""compile-on-hot-path: XLA compiles reachable from serving request handlers.
+
+The compile-lifecycle subsystem (common/compilecache.py) exists so that NO
+steady-state XLA compile happens on the request path: batch buckets are
+AOT-precompiled by the warmup ladder, and model-generation swaps prewarm
+off-path before flipping. This checker holds that invariant statically —
+the dynamic counterpart is the ``oryx_jit_compiles_total`` counter the
+bench asserts on. Flagged when reachable from an ``async def`` handler:
+
+  * constructing a ``jax.jit`` / ``jax.pjit`` wrapper (a compile on first
+    call, and a fresh compile cache per wrapper);
+  * ``<jitted>.lower(...)`` with arguments — the explicit trace+compile
+    entry point. Zero-argument ``.lower()`` is string case-folding and
+    stays silent.
+
+Reachability reuses the blocking-async checker's project call graph
+(core.call_edges). The sanctioned route is exempt: anything defined in, or
+called through, ``oryx_tpu.common.compilecache`` (``aot_compile`` et al.)
+is the warmup subsystem itself — by construction it runs off-path (batch
+warmer thread, startup) and its whole point is taking the compile."""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import (
+    call_edges,
+    method_classes,
+    module_map,
+    walk_scope,
+)
+
+ID = "compile-on-hot-path"
+
+_JIT_CTORS = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+#: the warmup subsystem: facts inside it are its job, and edges into it are
+#: the sanctioned way for everyone else to compile
+_EXEMPT_MODULE = "oryx_tpu.common.compilecache"
+
+
+class HotPathCompileChecker:
+    id = ID
+
+    def check(self, project) -> list:
+        module_of = module_map(project)
+
+        facts = {}   # key -> (line, cause) | None
+        edges = {}   # key -> [(line, callee_key, label)]
+        async_keys = set()
+        for fctx in project.files:
+            exempt_file = fctx.relpath.endswith("common/compilecache.py")
+            fn_class = method_classes(fctx)
+            for qual, fn in fctx.functions:
+                key = (fctx.relpath, qual)
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    async_keys.add(key)
+                facts[key] = None if exempt_file else self._direct_fact(fctx, fn)
+                edges[key] = [] if exempt_file else [
+                    e for e in call_edges(fctx, fn, fn_class, module_of)
+                    if not e[1][0].endswith("common/compilecache.py")
+                ]
+
+        # propagate "compiles" through the call graph
+        compiling = {k: v for k, v in facts.items() if v is not None}
+        changed = True
+        while changed:
+            changed = False
+            for key, outs in edges.items():
+                if key in compiling:
+                    continue
+                for line, callee, label in outs:
+                    if callee in compiling:
+                        _, cause = compiling[callee]
+                        compiling[key] = (line, f"{label} -> {cause}")
+                        changed = True
+                        break
+
+        out = []
+        for fctx in project.files:
+            for qual, fn in fctx.functions:
+                key = (fctx.relpath, qual)
+                if key not in async_keys:
+                    continue
+                direct = facts.get(key)
+                if direct is not None:
+                    line, cause = direct
+                    out.append(fctx.finding(
+                        ID, line,
+                        f"async `{qual}` compiles on the request path: {cause} "
+                        "(route it through the warmup subsystem — "
+                        "compilecache.aot_compile / the batch warmer)",
+                        symbol=qual,
+                    ))
+                    continue
+                for line, callee, label in edges[key]:
+                    if callee in compiling and callee not in async_keys:
+                        _, cause = compiling[callee]
+                        out.append(fctx.finding(
+                            ID, line,
+                            f"async `{qual}` calls {label} which compiles on "
+                            f"the request path ({cause}) — precompile it via "
+                            "the warmup subsystem (compilecache)",
+                            symbol=f"{qual}->{callee[1]}",
+                        ))
+                        break  # one finding per handler keeps the report readable
+        return out
+
+    @staticmethod
+    def _direct_fact(fctx, fn):
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = fctx.resolve(node.func)
+            if resolved in _JIT_CTORS:
+                return (
+                    node.lineno,
+                    "constructs a jax.jit wrapper (XLA compile on first call)",
+                )
+            if resolved and resolved.startswith(_EXEMPT_MODULE + "."):
+                continue  # the sanctioned AOT route
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "lower"
+                and (node.args or node.keywords)
+            ):
+                # .lower(shapes) — jax's explicit trace entry point; the
+                # zero-arg form is str.lower() and stays silent
+                return (
+                    node.lineno,
+                    f"`{ast.unparse(node.func)}(...)` lowers/compiles an XLA "
+                    "program",
+                )
+        return None

@@ -49,6 +49,85 @@ def walk_scope(fn_node: ast.AST):
             stack.extend(ast.iter_child_nodes(node))
 
 
+def module_name(relpath: str) -> str:
+    """Repo-relative path -> dotted module name (packages drop __init__)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def module_map(project) -> dict:
+    """Dotted module name -> FileContext for every file in the project."""
+    return {module_name(fctx.relpath): fctx for fctx in project.files}
+
+
+def method_classes(fctx) -> dict:
+    """Immediate method node -> owning class node (for self.method edges)."""
+    out = {}
+    for _, cnode in fctx.classes:
+        for child in cnode.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[child] = cnode
+    return out
+
+
+def call_edges(fctx, fn, fn_class: dict, module_of: dict) -> list:
+    """Resolvable call edges out of one function: local functions,
+    from-imports of project functions, ``module.fn``, and ``self.method``.
+    Returns (call_line, (relpath, qualname), display_label) triples — the
+    shared reachability substrate of the blocking-async and
+    compile-on-hot-path checkers. Callables merely REFERENCED (e.g. handed
+    to run_in_executor) are not calls and produce no edge."""
+    out = []
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            # local function, or from-import of a project function
+            local = fctx.functions_by_name.get(func.id)
+            if local:
+                target = min(local, key=lambda n: fctx.qualname_of[n].count("."))
+                out.append((node.lineno, (fctx.relpath, fctx.qualname_of[target]),
+                            f"`{func.id}()`"))
+                continue
+            origin = fctx.import_map.get(func.id)
+            if origin and "." in origin:
+                mod, _, name = origin.rpartition(".")
+                target_fctx = module_of.get(mod)
+                if target_fctx is not None and name in target_fctx.functions_by_name:
+                    t = target_fctx.functions_by_name[name][0]
+                    out.append((node.lineno,
+                                (target_fctx.relpath, target_fctx.qualname_of[t]),
+                                f"`{func.id}()`"))
+        elif isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                cnode = fn_class.get(fn)
+                if cnode is not None:
+                    for child in cnode.body:
+                        if (
+                            isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and child.name == func.attr
+                        ):
+                            out.append((node.lineno,
+                                        (fctx.relpath, fctx.qualname_of[child]),
+                                        f"`self.{func.attr}()`"))
+                            break
+                continue
+            resolved = fctx.resolve(func)
+            if resolved and "." in resolved:
+                mod, _, name = resolved.rpartition(".")
+                target_fctx = module_of.get(mod)
+                if target_fctx is not None and name in target_fctx.functions_by_name:
+                    t = target_fctx.functions_by_name[name][0]
+                    out.append((node.lineno,
+                                (target_fctx.relpath, target_fctx.qualname_of[t]),
+                                f"`{ast.unparse(func)}()`"))
+    return out
+
+
 @dataclasses.dataclass
 class Finding:
     checker: str

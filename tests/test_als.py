@@ -399,6 +399,32 @@ def test_serving_manager_model_swap_retains(tmp_path):
     assert mgr.get_model() is model1
 
 
+def test_prepare_blocked_parallel_pack_matches_serial():
+    """The chunked thread-pool pack writes the SAME slabs as a serial pack,
+    including under row skew (a hot row spanning many slots crosses scatter
+    chunk boundaries) and with pow2-misaligned shapes."""
+    from oryx_tpu.models.als import train as tr
+    from oryx_tpu.models.als.data import RatingBatch
+    from conftest import LenOnlyIDs
+
+    rng = np.random.default_rng(3)
+    for nnz, n_users, n_items in ((5_000, 301, 117), (120_000, 4_001, 773)):
+        rows = rng.integers(0, n_users, nnz).astype(np.int32)
+        cols = rng.integers(0, n_items, nnz).astype(np.int32)
+        rows[: nnz // 10] = 0  # hot row: many slots, chunk-boundary crossing
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        batch = RatingBatch(rows, cols, vals, LenOnlyIDs(n_users),
+                            LenOnlyIDs(n_items))
+        serial = tr.prepare_blocked(batch, 16, workers=1)
+        threaded = tr.prepare_blocked(batch, 16, workers=8)
+        for a, b in zip(serial, threaded):
+            assert a.block == b.block and a.slot_width == b.slot_width
+            np.testing.assert_array_equal(np.asarray(a.srows), np.asarray(b.srows))
+            np.testing.assert_array_equal(np.asarray(a.scols), np.asarray(b.scols))
+            np.testing.assert_array_equal(np.asarray(a.svals), np.asarray(b.svals))
+            np.testing.assert_array_equal(np.asarray(a.slens), np.asarray(b.slens))
+
+
 def test_time_ordered_train_test_split():
     """ALS holds out the LATEST data by timestamp, not a random sample
     (ALSUpdate.splitNewDataToTrainTest:326-343)."""

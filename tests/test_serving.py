@@ -342,8 +342,9 @@ def test_tls_serving(tmp_path):
 
 def test_precompile_batches_warms_pow2_ladder(tmp_path, monkeypatch):
     """With precompile-batches on, a ready model's batched top-N programs
-    are exercised in the background at pow2 sizes (largest first) so a
-    MODEL handoff's first client burst pays no XLA compiles."""
+    are exercised in the background at pow2 sizes (smallest first, so the
+    replica turns ready incrementally) and a MODEL handoff's first client
+    burst pays no XLA compiles."""
     from oryx_tpu.models.als.serving import ALSServingModel
 
     sizes = []
@@ -382,7 +383,12 @@ def test_precompile_batches_warms_pow2_ladder(tmp_path, monkeypatch):
             time.sleep(0.1)
         else:
             pytest.fail("warmer never warmed a model")
-        assert sizes[:5] == [16, 8, 4, 2, 1], sizes
+        assert sizes[:5] == [1, 2, 4, 8, 16], sizes
+        # the completed ladder marked the shared warmup state warm-ready
+        from oryx_tpu.common import compilecache
+
+        assert compilecache.warmup_state().ready(1.0)
+        assert compilecache.warmup_state().snapshot() == {"done": 5, "total": 5}
     finally:
         layer.close()
         tp.reset_memory_brokers()

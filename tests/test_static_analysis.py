@@ -242,6 +242,62 @@ def test_blocking_async_propagates_through_project_calls():
 
 
 # ---------------------------------------------------------------------------
+# compile-on-hot-path
+# ---------------------------------------------------------------------------
+
+
+def test_hot_compile_fires_on_jit_in_handler():
+    hits = _run(
+        """
+        import jax
+
+        async def score(request, fn, x):
+            g = jax.jit(fn)  # compile on the request path
+            return g(x)
+        """,
+        "compile-on-hot-path",
+    )
+    assert len(hits) == 1 and "jax.jit" in hits[0].message
+
+
+def test_hot_compile_propagates_through_lower_helper():
+    helper = """
+        def compile_now(jitted, x):
+            return jitted.lower(x).compile()
+    """
+    hits = _run(
+        """
+        from helper import compile_now
+
+        async def handler(request, jitted, x):
+            return compile_now(jitted, x)(x)
+        """,
+        "compile-on-hot-path",
+        extra_sources={"helper.py": textwrap.dedent(helper)},
+    )
+    assert len(hits) == 1 and "compile_now" in hits[0].message
+
+
+def test_hot_compile_quiet_on_warmup_route_and_str_lower():
+    hits = _run(
+        """
+        from oryx_tpu.common import compilecache
+
+        async def handler(request, jitted, shapes, name):
+            # sanctioned: the warmup subsystem takes the compile off-path
+            compilecache.aot_compile(jitted, shapes)
+            return name.lower()  # zero-arg .lower() is string case-folding
+
+        def warm(model, jitted, shapes):
+            # sync warm hook: not reachable from any async handler here
+            return jitted.lower(shapes).compile()
+        """,
+        "compile-on-hot-path",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
 # lock-discipline
 # ---------------------------------------------------------------------------
 
