@@ -36,6 +36,17 @@ class OryxServingException(Exception):
         self.message = message or str(status)
 
 
+class OverloadedException(OryxServingException):
+    """Load shed: the serving tier refused the request up front (503 with a
+    Retry-After hint) because its coalescer queue is past the configured
+    depth — fail fast and cheap instead of queueing into timeout."""
+
+    def __init__(self, message: str = "overloaded; retry later",
+                 retry_after_sec: float = 1.0):
+        super().__init__(503, message)
+        self.retry_after_sec = retry_after_sec
+
+
 class ServingModelManager(abc.ABC):
     """Maintains the in-memory serving model from the update topic."""
 

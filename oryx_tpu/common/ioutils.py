@@ -51,3 +51,39 @@ def mkdirs(path: str | Path) -> Path:
     p = Path(path)
     p.mkdir(parents=True, exist_ok=True)
     return p
+
+
+def atomic_write_bytes(path: str | Path, data: bytes) -> None:
+    """Crash-safe file replacement: write a UNIQUE temp file in the target
+    directory, fsync it, then ``os.replace`` over the destination.
+
+    A writer killed at any point leaves either the old complete file or the
+    new complete file — never a torn mix — and the pid+object-id temp name
+    means two concurrent writers cannot interleave bytes in one temp file
+    (the last rename wins whole). Used for broker offset/metadata commits,
+    where a torn write would corrupt resume positions for a whole consumer
+    group."""
+    p = Path(path)
+    tmp = p.with_name(f".{p.name}.{os.getpid()}.{id(data):x}.tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        # loop over short writes: renaming a partially-written temp into
+        # place would install exactly the torn file this helper exists to
+        # prevent
+        view = memoryview(data)
+        written = 0
+        while written < len(view):
+            written += os.write(fd, view[written:])
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    try:
+        os.replace(tmp, p)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_text(path: str | Path, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))

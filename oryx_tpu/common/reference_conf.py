@@ -59,6 +59,10 @@ oryx = {
   batch = {
     streaming = {
       generation-interval-sec = 21600
+      # Reference parity: any generation exception kills the layer. Off by
+      # default — transient generations retry with backoff, poison
+      # generations quarantine (offsets advance; docs/robustness.md).
+      fatal-on-error = false
       config = ${oryx.default-compute-config}
     }
     update-class = null
@@ -76,6 +80,8 @@ oryx = {
   speed = {
     streaming = {
       generation-interval-sec = 10
+      # Same semantics as oryx.batch.streaming.fatal-on-error.
+      fatal-on-error = false
       config = ${oryx.default-compute-config}
     }
     model-manager-class = null
@@ -97,6 +103,12 @@ oryx = {
       key-alias = null
       read-only = false
       context-path = "/"
+      # Per-request time budget (seconds): past it the request answers 504
+      # carrying the partial trace id, and downstream work that has not
+      # started yet (a queued coalesced device call) is abandoned. The
+      # budget rides a contextvar (common/resilience.py Deadline) across
+      # executor hops exactly like the span context. 0 disables.
+      request-timeout-sec = 0
     }
     application-resources = null
     model-manager-class = null
@@ -130,6 +142,11 @@ oryx = {
       # handoff does not pay XLA compiles. Off by default; turn on for
       # production accelerator deployments.
       precompile-batches = false
+      # Load shedding: when more than this many requests are already queued
+      # for a coalesced device call, new arrivals answer 503 + Retry-After
+      # immediately (oryx_shed_requests_total) instead of growing the queue
+      # without bound. 0 disables (unbounded queue).
+      max-queue-depth = 0
     }
   }
 
@@ -172,6 +189,59 @@ oryx = {
     # before being promoted anyway (warmer died, warm keeps failing). 0
     # disables the valve.
     swap-deadline-sec = 120
+  }
+
+  # Fault-tolerance subsystem (common/resilience.py): process-wide retry
+  # policy, generation quarantine, circuit breaking, and supervised
+  # consumer restart (docs/robustness.md has the failure model per tier).
+  resilience = {
+    # Retry shape for transient transport faults (broker append/read/offset
+    # ops): exponential backoff with full jitter, bounded by attempts AND
+    # wall time. Outcomes are visible in oryx_retries_total{site,outcome}.
+    retry = {
+      max-attempts = 4
+      base-delay-ms = 50
+      max-delay-ms = 2000
+      max-elapsed-sec = 30
+    }
+    # Microbatch generations: re-attempts before the generation is
+    # quarantined (offsets advance past the poison input; counted in
+    # oryx_quarantined_generations_total). Backoff shape comes from
+    # resilience.retry above.
+    generation = {
+      max-retries = 2
+    }
+    # Device-call circuit breaker on the serving coalescer: this many
+    # consecutive batched-call failures open it (requests degrade to
+    # uncoalesced per-request scans), one probe is admitted every reset-sec
+    # and closes it on success. State + transitions are /metrics gauges.
+    breaker = {
+      failure-threshold = 5
+      reset-sec = 10
+      half-open-probes = 1
+    }
+    # Supervised restart of the serving update-consumer thread: a crashed
+    # or wedged consumer restarts from the update topic's earliest offset
+    # (full state replay — safe by construction) after a backed-off delay
+    # instead of leaving /readyz stale forever. max-restarts < 0 = never
+    # give up.
+    consumer-restart = {
+      max-restarts = -1
+      base-delay-ms = 100
+      max-delay-ms = 5000
+    }
+  }
+
+  # Deterministic fault injection (common/faults.py): when enabled with a
+  # spec, named hot-path sites (broker.append, broker.read, broker.offset,
+  # serving.update_consume, serving.device_call) follow exact seeded
+  # failure schedules — "broker.append=fail:3;serving.device_call=rate:0.1"
+  # — so chaos drills exercise the real retry/breaker/restart paths. No-op
+  # when disabled (the production default; docs/robustness.md cookbook).
+  faults = {
+    enabled = false
+    seed = 0
+    spec = null
   }
 
   # Framework-wide metrics registry + Prometheus text exposition on

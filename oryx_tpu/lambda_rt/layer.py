@@ -16,7 +16,9 @@ from typing import Callable, Sequence
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
+from oryx_tpu.common import faults
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
@@ -29,6 +31,23 @@ log = spans.get_logger(__name__)
 #: dropped remainder is still counted in the generation span's attributes).
 MAX_TRACED_INPUTS_PER_GENERATION = 128
 
+_QUARANTINED = metrics_mod.default_registry().counter(
+    "oryx_quarantined_generations_total",
+    "Microbatch generations abandoned after exhausting retries (offsets "
+    "advanced past the poison input; the layer kept running)",
+    ("tier",),
+)
+_CORRUPT = metrics_mod.default_registry().counter(
+    "oryx_corrupt_records_total",
+    "Corrupt input-topic records dropped by the microbatch pump",
+    ("tier",),
+)
+_LAYER_FAILURES = metrics_mod.default_registry().counter(
+    "oryx_layer_failures_total",
+    "Fatal layer-thread failures (the layer closed because of one)",
+    ("tier",),
+)
+
 
 class AbstractLayer:
     def __init__(self, config, tier: str):
@@ -40,6 +59,8 @@ class AbstractLayer:
         # process restart; the shared persistent compilation cache (and the
         # compile counter) applies to them exactly as to serving replicas
         compilecache.configure(config)
+        resilience.configure(config)
+        faults.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")
@@ -49,10 +70,30 @@ class AbstractLayer:
         self.generation_interval_sec = config.get_float(
             f"oryx.{tier}.streaming.generation-interval-sec"
         )
+        # reference parity knob: the original Spark semantics made any
+        # on_batch exception fatal to the layer; default off — transient
+        # generations retry, poison generations quarantine
+        self.fatal_on_error = config.get_bool(
+            f"oryx.{tier}.streaming.fatal-on-error", False
+        )
+        gen_policy = resilience.RetryPolicy.from_config(
+            config, retryable=lambda e: True
+        )
+        gen_policy.max_attempts = 1 + max(
+            0, config.get_int("oryx.resilience.generation.max-retries", 2)
+        )
+        # generation retries are bounded by ATTEMPTS only: inheriting the
+        # transport policy's max-elapsed wall budget (sized for broker ops)
+        # would classify the FIRST failure of any generation that ran past
+        # it — batch generations legitimately run for minutes — as
+        # exhausted, silently disabling max-retries where it matters most
+        gen_policy.max_elapsed_sec = float("inf")
+        self._generation_policy = gen_policy
         self._group = f"OryxGroup-{tier}-{self.id}" if self.id else None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._failure: BaseException | None = None
+        self._failure_raised = False
         self._context: ComputeContext | None = None
 
     # -- context ------------------------------------------------------------
@@ -84,7 +125,12 @@ class AbstractLayer:
         broker = tp.get_broker(self.input_broker)
         offsets: dict[int, int] = {}
         for p in range(broker.num_partitions(self.input_topic)):
-            stored = broker.get_offset(self._group, self.input_topic, p) if self._group else None
+            stored = (
+                self._offset_op(
+                    lambda p=p: broker.get_offset(self._group, self.input_topic, p)
+                )
+                if self._group else None
+            )
             offsets[p] = stored if stored is not None else broker.size(self.input_topic, p)
         return offsets
 
@@ -93,7 +139,24 @@ class AbstractLayer:
         if self._group:
             broker = tp.get_broker(self.input_broker)
             for p, off in offsets.items():
-                broker.set_offset(self._group, self.input_topic, off, p)
+                self._offset_op(
+                    lambda p=p, off=off: broker.set_offset(
+                        self._group, self.input_topic, off, p
+                    )
+                )
+
+    def _offset_op(self, fn):
+        """One offset-store read/write, retried through transient failures
+        (the control plane rides the same flaky filesystem as the data)."""
+
+        def _do():
+            faults.maybe_fail("broker.offset")
+            return fn()
+
+        return resilience.default_policy().call(
+            "broker.offset", _do, retryable=tp.transient_transport_error,
+            stop=self._stop,
+        )
 
     # -- microbatch pump ----------------------------------------------------
     def run_microbatches(
@@ -103,9 +166,17 @@ class AbstractLayer:
         start_offset: "dict[int, int] | None" = None,
     ) -> None:
         """Every generation interval, hand the new input slice (across all
-        input partitions) to on_batch — the foreachRDD loop. Runs until stop;
-        an on_batch exception is fatal to the layer (reference fatal-on-error
-        semantics).
+        input partitions) to on_batch — the foreachRDD loop. Runs until stop.
+
+        Failure semantics (docs/robustness.md): an on_batch exception is
+        retried with backoff up to ``oryx.resilience.generation.max-retries``
+        times (transient faults — a flaky broker, a briefly-wedged device —
+        recover in place), then the generation is QUARANTINED: offsets
+        advance past it, ``oryx_quarantined_generations_total`` counts it,
+        the generation span records the error, and the layer lives on. With
+        ``oryx.<tier>.streaming.fatal-on-error`` the first exception kills
+        the layer (reference parity). Input-poll failures past the transport
+        retry budget skip the tick without advancing offsets.
 
         ``start_offset`` should be resolved synchronously in start() so input
         produced after start() returns is never skipped by a slow-to-schedule
@@ -118,16 +189,51 @@ class AbstractLayer:
             if self._stop.is_set():
                 break
             batch: list[KeyMessage] = []
-            for p in range(broker.num_partitions(self.input_topic)):
-                offset = offsets.get(p, 0)
-                end = broker.size(self.input_topic, p)
-                while offset < end:
-                    chunk = broker.read(self.input_topic, offset, end - offset, partition=p)
-                    if not chunk:
-                        break
-                    batch.extend(km for km in chunk if km is not tp.CORRUPT_RECORD)
-                    offset += len(chunk)
-                offsets[p] = offset
+            n_corrupt = 0
+            first_corrupt: "tuple[int, int] | None" = None
+            # stage offset advances in a COPY: a poll failure on a LATER
+            # partition must discard the half-built batch and the earlier
+            # partitions' advances TOGETHER — advancing the shared dict
+            # in place would silently skip the already-read messages on
+            # the re-poll (batch dropped, offsets kept)
+            new_offsets = dict(offsets)
+            try:
+                for p in range(broker.num_partitions(self.input_topic)):
+                    offset = new_offsets.get(p, 0)
+                    end = broker.size(self.input_topic, p)
+                    while offset < end:
+                        chunk = self._poll_input(broker, p, offset, end - offset)
+                        if not chunk:
+                            break
+                        for i, km in enumerate(chunk):
+                            if km is tp.CORRUPT_RECORD:
+                                n_corrupt += 1
+                                if first_corrupt is None:
+                                    first_corrupt = (p, offset + i)
+                            else:
+                                batch.append(km)
+                        offset += len(chunk)
+                    new_offsets[p] = offset
+            except Exception:  # noqa: BLE001 — poll failure past retry budget
+                # transient input-poll failure that outlasted the transport
+                # retries: skip this tick WITHOUT advancing offsets — the
+                # next tick re-polls the same positions. Killing the layer
+                # over a pollable fault is the fragility this path removes.
+                log.warning(
+                    "input poll failed past the retry budget; re-polling next "
+                    "generation", exc_info=True,
+                )
+                continue
+            offsets = new_offsets
+            if n_corrupt:
+                # one rate-limited (per-generation) line, not one per record:
+                # a corrupted log segment would otherwise flood the logger
+                _CORRUPT.labels(self.tier).inc(n_corrupt)
+                log.warning(
+                    "dropped %d corrupt record(s) this generation "
+                    "(first at partition %d offset %d)",
+                    n_corrupt, first_corrupt[0], first_corrupt[1],
+                )
             timestamp_ms = int(time.time() * 1000)
             # trace continuation across the input-topic hop: each traced
             # message gets a span parented into ITS ingress trace (so the
@@ -156,13 +262,54 @@ class AbstractLayer:
                     links=[s.context for s in msg_spans],
                     attributes={"route": f"{self.tier}.generation",
                                 "items": len(batch), "traced_inputs": n_traced},
-                ):
+                ) as gen_span:
                     with self.tracer.step("generation", n_items=len(batch)):
-                        on_batch(timestamp_ms, batch)
+                        self._run_generation(
+                            on_batch, timestamp_ms, batch, gen_span
+                        )
             finally:
                 for s in msg_spans:
                     spans.finish_span(s)
             self.store_input_offset(offsets)
+
+    def _run_generation(self, on_batch, timestamp_ms: int,
+                        batch: "list[KeyMessage]", gen_span) -> None:
+        """One generation through the transient-vs-poison machinery; raises
+        only on fatal-on-error (or during shutdown) — a quarantined
+        generation returns normally so the caller advances offsets."""
+        if self.fatal_on_error:
+            # reference parity: no retry, first raise kills the layer
+            on_batch(timestamp_ms, batch)
+            return
+        try:
+            self._generation_policy.call(
+                f"{self.tier}.generation",
+                lambda: on_batch(timestamp_ms, batch),
+                stop=self._stop,
+            )
+        except Exception as e:  # noqa: BLE001 — quarantine after retries
+            if self._stop.is_set():
+                raise  # shutting down: spawn's guard discards it
+            _QUARANTINED.labels(self.tier).inc()
+            gen_span.record_exception(e)
+            gen_span.set_attribute("quarantined", True)
+            gen_span.set_attribute("items", len(batch))
+            log.error(
+                "quarantining generation after retries: advancing past %d "
+                "input item(s)", len(batch), exc_info=True,
+            )
+
+    def _poll_input(self, broker, partition: int, offset: int, n: int):
+        """One input-slice read, retried through transient broker failures."""
+
+        def _read():
+            faults.maybe_fail("broker.read")
+            return broker.read(self.input_topic, offset, n, partition=partition)
+
+        return resilience.default_policy().call(
+            "broker.read", _read, retryable=tp.transient_transport_error,
+            stop=self._stop,
+        )
 
     # -- threads / lifecycle ------------------------------------------------
     def spawn(self, name: str, fn: Callable[[], None]) -> threading.Thread:
@@ -172,6 +319,7 @@ class AbstractLayer:
             except Exception as e:  # noqa: BLE001
                 if not self._stop.is_set():
                     log.exception("fatal error in %s; closing layer", name)
+                    _LAYER_FAILURES.labels(self.tier).inc()
                     self._failure = e
                     self._stop.set()
 
@@ -189,10 +337,15 @@ class AbstractLayer:
         return classutils.load_instance_of(name, expected_type, self.config)
 
     def await_termination(self, timeout: float | None = None) -> None:
+        """Block until stop; a layer failure is raised exactly ONCE — callers
+        polling await_termination in a supervision loop see it the first
+        time and a clean return after (it is also already surfaced through
+        oryx_layer_failures_total and the spawn-side log line)."""
         self._stop.wait(timeout)
         for t in self._threads:
             t.join(timeout=5)
-        if self._failure is not None:
+        if self._failure is not None and not self._failure_raised:
+            self._failure_raised = True
             raise self._failure
 
     def close(self) -> None:

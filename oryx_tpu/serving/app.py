@@ -28,7 +28,10 @@ from aiohttp import web
 from oryx_tpu.api.serving import ServingModelManager
 from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
+from oryx_tpu.common import faults
+from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
@@ -63,6 +66,16 @@ _UPDATE_LAG_SECONDS = metrics_mod.default_registry().gauge(
     "oryx_serving_update_lag_seconds",
     "Seconds since the serving layer last consumed an update message",
 )
+_CONSUMER_RESTARTS = metrics_mod.default_registry().counter(
+    "oryx_serving_consumer_restarts_total",
+    "Supervised restarts of the update-consumer thread after a crash",
+)
+
+#: Healthy consumption this long refunds the consumer restart budget and
+#: resets its backoff: supervisor semantics are restarts-per-unhealthy-WINDOW,
+#: not per process lifetime — isolated weekly crashes must never accumulate
+#: into a max-restarts give-up months later.
+_CONSUMER_HEALTHY_RESET_SEC = 60.0
 
 
 def _route_template(request: web.Request) -> str:
@@ -164,7 +177,7 @@ def _lag_messages_fn(metered_ref):
             return 0.0
         try:
             lag = metered._broker.total_size(metered._topic) - metered._consumed
-        except Exception:  # noqa: BLE001 — lag is advisory
+        except Exception:  # noqa: BLE001  # analyze: ignore[swallowed-exception] -- scrape-time lag probe is advisory; a log line per scrape would flood
             return 0.0
         return float(max(0, lag))
 
@@ -210,6 +223,10 @@ class _MeteredUpdates:
         return self
 
     def __next__(self):
+        # chaos hook: an armed "serving.update_consume" schedule crashes the
+        # consumer HERE, through the exact path a poison update or broker
+        # fault would take (the supervised restart loop absorbs it)
+        faults.maybe_fail("serving.update_consume")
         # entering = the manager finished the previous message: progress.
         # The timestamps are NOT behind the metrics kill switch — /readyz
         # derives staleness from them, and readiness must not depend on
@@ -227,6 +244,33 @@ class _MeteredUpdates:
         if metrics_mod.default_registry().enabled:
             _UPDATES_CONSUMED.inc()
         return km
+
+
+def _deadline_middleware(config):
+    """Per-request deadline (``oryx.serving.api.request-timeout-sec``): the
+    budget is set as the request's :class:`resilience.Deadline` contextvar
+    (downstream code — the coalescer dispatch — refuses to START work past
+    it) and enforced at this level with ``asyncio.wait_for``. A blown
+    budget answers 504 carrying the PARTIAL trace id: every span the
+    request recorded before cancellation is already in the ring, so the
+    operator can see exactly where the time went. None when disabled."""
+    budget = config.get_float("oryx.serving.api.request-timeout-sec", 0.0)
+    if budget <= 0:
+        return None
+
+    @web.middleware
+    async def deadline_mw(request, handler):
+        with resilience.deadline(budget):
+            try:
+                return await asyncio.wait_for(handler(request), timeout=budget)
+            except asyncio.TimeoutError:
+                return web.json_response({
+                    "error": f"request exceeded its {budget:.3f}s budget",
+                    "status": 504,
+                    "trace_id": spans.current_trace_id(),
+                }, status=504)
+
+    return deadline_mw
 
 
 @web.middleware
@@ -248,7 +292,14 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     metrics_mod.configure(config)
     spans.configure(config)
     compilecache.configure(config)
+    resilience.configure(config)
+    faults.configure(config)
     middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
+    dl_mw = _deadline_middleware(config)
+    if dl_mw is not None:
+        # inside metrics (the 504 must be counted + span-stamped), outside
+        # the error mapper (the budget covers handler + error rendering)
+        middlewares.insert(1, dl_mw)
     auth_mw = _auth_middleware(config)
     if auth_mw is not None:
         middlewares.append(auth_mw)
@@ -266,6 +317,14 @@ def make_app(config, manager, input_producer=None) -> web.Application:
             config.get_int("oryx.serving.compute.coalesce-max-batch", 256),
             config.get_int("oryx.serving.compute.coalesce-inflight", 2),
             config.get_float("oryx.serving.compute.coalesce-deadline-ms", 250.0),
+            max_queue_depth=config.get_int(
+                "oryx.serving.compute.max-queue-depth", 0
+            ),
+            # device-call breaker: batched-call failures open it and route
+            # requests to uncoalesced per-request scans until a probe heals
+            breaker=resilience.CircuitBreaker.from_config(
+                "serving.device_call", config
+            ),
         )
 
     modules = list(DEFAULT_RESOURCES)
@@ -620,6 +679,8 @@ class ServingLayer:
         self.secure_port = config.get_int("oryx.serving.api.secure-port")
         self.manager: ServingModelManager | None = None
         self._update_iterator: ConsumeDataIterator | None = None
+        self._metered_updates: "_MeteredUpdates | None" = None
+        self.consumer_restarts = 0  # observability + tests
         self._consumer_thread: threading.Thread | None = None
         self._server_thread: threading.Thread | None = None
         self._warmer: _BatchWarmer | None = None
@@ -632,6 +693,10 @@ class ServingLayer:
         # cache + compile accounting first: the persistent compilation cache
         # must be live before the FIRST model compile of this process
         compilecache.configure(self.config)
+        # retry shapes + fault schedules must be live before the update
+        # consumer below takes its first message (make_app runs after it)
+        resilience.configure(self.config)
+        faults.configure(self.config)
         # topics must exist (ModelManagerListener.contextInitialized:107-127)
         if not self.config.get_bool("oryx.serving.no-init-topics", False):
             for burl, bt in ((self.input_broker, self.input_topic),
@@ -647,18 +712,64 @@ class ServingLayer:
         self._update_iterator = ConsumeDataIterator(
             update_broker, self.update_topic, "earliest"
         )
-        metered_updates = _MeteredUpdates(
+        self._metered_updates = _MeteredUpdates(
             self._update_iterator, update_broker, self.update_topic
         )
+        restart_cfg = self.config.get_config("oryx.resilience.consumer-restart")
+        max_restarts = restart_cfg.get_int("max-restarts", -1)
+        base_delay = restart_cfg.get_float("base-delay-ms", 100.0) / 1000.0
+        max_delay = restart_cfg.get_float("max-delay-ms", 5000.0) / 1000.0
 
         def consume():
-            try:
-                self.manager.consume(metered_updates)
-            except Exception as e:  # noqa: BLE001
-                if not self._stopped.is_set():
-                    log.exception("fatal error consuming updates; closing layer")
-                    self._failure = e
-                    self.close()
+            # SUPERVISED: before this loop existed, one crash (or one poison
+            # update) silently ended the consumer thread — the layer kept
+            # serving an ever-staler model until /readyz noticed. Now each
+            # crash restarts consumption from "earliest" (full state replay:
+            # exactly how a fresh replica builds its model, so correct by
+            # construction) after a bounded-exponential delay, while the
+            # HTTP side keeps answering from the current in-memory model.
+            restarts = 0
+            while not self._stopped.is_set():
+                attempt_started = time.monotonic()
+                try:
+                    self.manager.consume(self._metered_updates)
+                    return  # iterator closed: clean shutdown
+                except Exception as e:  # noqa: BLE001 — supervised
+                    if self._stopped.is_set():
+                        return
+                    if (
+                        time.monotonic() - attempt_started
+                        >= _CONSUMER_HEALTHY_RESET_SEC
+                    ):
+                        restarts = 0  # budget is per unhealthy window
+                    restarts += 1
+                    self.consumer_restarts += 1  # lifetime-cumulative (tests)
+                    _CONSUMER_RESTARTS.inc()
+                    if 0 <= max_restarts < restarts:
+                        log.exception(
+                            "update consumer failed %d times; giving up and "
+                            "closing the layer", restarts,
+                        )
+                        self._failure = e
+                        self.close()
+                        return
+                    delay = min(max_delay, base_delay * (2 ** (restarts - 1)))
+                    log.exception(
+                        "update consumer crashed (restart %d); restarting "
+                        "from earliest in %.2fs", restarts, delay,
+                    )
+                    if self._stopped.wait(delay):
+                        return
+                    ioutils.close_quietly(self._update_iterator)
+                    self._update_iterator = ConsumeDataIterator(
+                        update_broker, self.update_topic, "earliest"
+                    )
+                    self._metered_updates = _MeteredUpdates(
+                        self._update_iterator, update_broker, self.update_topic
+                    )
+                    # loop re-checks _stopped before consuming again, so a
+                    # close() racing the rebuild cannot strand a consumer
+                    # blocked on a just-created iterator
 
         self._consumer_thread = threading.Thread(
             target=consume, name="OryxServingLayerUpdateConsumerThread", daemon=True

@@ -25,7 +25,10 @@ import asyncio
 
 import numpy as np
 
+from oryx_tpu.api.serving import OverloadedException
+from oryx_tpu.common import faults
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 
 log = spans.get_logger(__name__)
@@ -46,6 +49,21 @@ _DEADLINE_FLUSHES = metrics_mod.default_registry().counter(
 _PAD_WASTE = metrics_mod.default_registry().counter(
     "oryx_coalescer_pad_waste_rows_total",
     "Padding rows added to reach power-of-two batch shapes",
+)
+_SHED = metrics_mod.default_registry().counter(
+    "oryx_shed_requests_total",
+    "Requests refused up front (503 + Retry-After) because the coalescer "
+    "queue exceeded oryx.serving.compute.max-queue-depth",
+)
+_DEGRADED = metrics_mod.default_registry().counter(
+    "oryx_breaker_degraded_requests_total",
+    "Requests served WITHOUT coalescing because the device-call circuit "
+    "breaker was open (per-request fallback scans on the current model)",
+)
+_DEADLINE_DROPS = metrics_mod.default_registry().counter(
+    "oryx_coalescer_deadline_dropped_total",
+    "Queued requests whose per-request deadline expired before dispatch "
+    "(answered 504 without spending a device call on them)",
 )
 
 
@@ -69,10 +87,10 @@ def pow2_buckets(max_batch: int) -> list[int]:
 
 class _Pending:
     __slots__ = ("vec", "want", "how_many", "offset", "allowed", "excluded",
-                 "future", "enq_t", "wait_span")
+                 "future", "enq_t", "wait_span", "deadline")
 
     def __init__(self, vec, how_many, offset, allowed, excluded, future,
-                 enq_t: float = 0.0, wait_span=None):
+                 enq_t: float = 0.0, wait_span=None, deadline=None):
         self.vec = vec
         self.want = how_many + offset
         self.how_many = how_many
@@ -85,6 +103,9 @@ class _Pending:
         # ingress span (contextvars do NOT cross the executor hop, so the
         # span object itself is the carrier), closed at dispatch
         self.wait_span = wait_span
+        # the request's Deadline, captured at enqueue for the same reason:
+        # the executor-side dispatch checks it before spending device time
+        self.deadline = deadline
 
 
 class TopNCoalescer:
@@ -113,7 +134,8 @@ class TopNCoalescer:
     (a MODEL handoff mid-flight) are grouped by model identity at flush."""
 
     def __init__(self, window_ms: float = 1.0, max_batch: int = 256,
-                 max_inflight: int = 2, deadline_ms: float = 250.0):
+                 max_inflight: int = 2, deadline_ms: float = 250.0,
+                 max_queue_depth: int = 0, breaker=None):
         self.window_s = window_ms / 1000.0
         # floor to a power of two: batches pad up to a pow2 for stable jit
         # signatures, and padding must never exceed the configured cap
@@ -121,16 +143,46 @@ class TopNCoalescer:
         self.max_batch = floor_pow2(max_batch)
         self.max_inflight = max(1, max_inflight)
         self.deadline_s = max(0.0, deadline_ms) / 1000.0
+        # load shed past this queue depth (0 = unbounded); the Retry-After
+        # hint is roughly one device round-trip — the queue-wait deadline
+        self.max_queue_depth = max(0, max_queue_depth)
+        # device-call circuit breaker (common/resilience.py); None = always
+        # coalesce. Callers consult admit() BEFORE routing a request here.
+        self.breaker = breaker
         self._pending: list[tuple[object, _Pending]] = []
         self._flusher: asyncio.TimerHandle | None = None
         self._deadline_timer: asyncio.TimerHandle | None = None
         self._inflight = 0
         self.deadline_flushes = 0  # observability + tests
+        self.shed_requests = 0
+        self.degraded_requests = 0
+
+    def admit(self) -> bool:
+        """Breaker admission for the coalesced path: False while the
+        device-call breaker is open (callers degrade to per-request scans
+        on the current model instead of erroring); half-open admits the
+        breaker's probe quota so a recovered device closes it again."""
+        if self.breaker is None or self.breaker.allow():
+            return True
+        self.degraded_requests += 1
+        _DEGRADED.inc()
+        return False
 
     async def top_n(self, model, query_vec, how_many: int, offset: int = 0,
                     allowed=None, excluded=None) -> list:
         """Coalesced equivalent of ``model.top_n(...)`` (no rescore)."""
         loop = asyncio.get_running_loop()
+        if self.max_queue_depth and len(self._pending) >= self.max_queue_depth:
+            # shed NOW, before queueing: a 503 in microseconds beats a 200
+            # after a timeout-sized queue wait, and the client's retry lands
+            # on a drained queue (or another replica)
+            self.shed_requests += 1
+            _SHED.inc()
+            raise OverloadedException(
+                f"coalescer queue depth {len(self._pending)} >= "
+                f"{self.max_queue_depth}",
+                retry_after_sec=max(1.0, self.deadline_s),
+            )
         fut = loop.create_future()
         wait_span = spans.start_span(
             "coalescer.queue_wait",
@@ -139,6 +191,7 @@ class TopNCoalescer:
         self._pending.append((model, _Pending(
             np.asarray(query_vec, dtype=np.float32), how_many, offset,
             allowed, excluded, fut, loop.time(), wait_span,
+            resilience.current_deadline(),
         )))
         self._maybe_flush(loop)
         return await fut
@@ -270,9 +323,36 @@ class TopNCoalescer:
         the loop) is parented into the first waiter's trace and *linked* to
         every waiter's queue-wait span, so each participating trace can
         find the shared call — and its batch-size/pad-waste attributes —
-        that answered it."""
+        that answered it.
+
+        Resilience (docs/robustness.md): requests whose per-request
+        Deadline expired while queued are answered 504 here WITHOUT
+        spending device time on them; a failed batch reports to the
+        device-call circuit breaker and each of its requests retries as an
+        uncoalesced per-request scan (degraded mode) before any client
+        sees an error."""
+        live: list[_Pending] = []
+        for p in group:
+            if p.deadline is not None and p.deadline.expired():
+                _DEADLINE_DROPS.inc()
+                loop.call_soon_threadsafe(
+                    _set_exception, p.future,
+                    resilience.DeadlineExceeded(
+                        "deadline expired in the coalescer queue"
+                    ),
+                )
+            else:
+                live.append(p)
+        if len(live) < len(group):
+            call_span.set_attribute("deadline.dropped", len(group) - len(live))
+        group = live
+        if not group:
+            spans.finish_span(call_span)
+            loop.call_soon_threadsafe(self._done, loop)
+            return
         try:
             with spans.activate(call_span):
+                faults.maybe_fail("serving.device_call")
                 qs = np.stack([p.vec for p in group])
                 want = max(p.want for p in group)
                 alloweds = (
@@ -303,17 +383,54 @@ class TopNCoalescer:
                     if excluded is not None:
                         excluded = list(excluded) + [None] * (n_pad - n_real)
                 results = model.top_n_batch(qs, want, alloweds, excluded)
+            if self.breaker is not None:
+                self.breaker.record_success()
             for p, res in zip(group, results):
                 out = res[p.offset:p.offset + p.how_many]
                 loop.call_soon_threadsafe(_set_result, p.future, out)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            if self.breaker is not None:
+                self.breaker.record_failure()
             call_span.record_exception(e)
-            log.exception("coalesced top-N batch failed")
-            for p in group:
-                loop.call_soon_threadsafe(_set_exception, p.future, e)
+            log.exception(
+                "coalesced top-N batch failed; retrying its %d request(s) "
+                "individually", len(group),
+            )
+            self._fallback_individually(loop, model, group, e)
         finally:
             spans.finish_span(call_span)
             loop.call_soon_threadsafe(self._done, loop)
+
+    def _fallback_individually(self, loop, model, group: list[_Pending],
+                               batch_exc: BaseException) -> None:
+        """Degraded completion of a failed batch: each request re-runs as an
+        uncoalesced per-request scan on the same model (the path an open
+        breaker routes NEW requests to), so one bad batched program — or an
+        injected device fault — costs latency, not errors. A request whose
+        fallback also fails gets the ORIGINAL batch exception: that is the
+        failure that actually broke it."""
+        direct = getattr(model, "top_n", None)
+        for p in group:
+            if p.deadline is not None and p.deadline.expired():
+                loop.call_soon_threadsafe(
+                    _set_exception, p.future,
+                    resilience.DeadlineExceeded(
+                        "deadline expired during degraded retry"
+                    ),
+                )
+                continue
+            if direct is None:
+                loop.call_soon_threadsafe(_set_exception, p.future, batch_exc)
+                continue
+            try:
+                res = direct(p.vec, p.how_many, p.offset, p.allowed, None,
+                             excluded=p.excluded)
+            except Exception:  # noqa: BLE001 — the batch exception is the story
+                log.exception("degraded per-request fallback also failed")
+                loop.call_soon_threadsafe(_set_exception, p.future, batch_exc)
+            else:
+                _DEGRADED.inc()
+                loop.call_soon_threadsafe(_set_result, p.future, res)
 
 
 def _set_result(future: asyncio.Future, value) -> None:

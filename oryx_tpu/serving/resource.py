@@ -21,6 +21,7 @@ from typing import Any
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 
 log = spans.get_logger(__name__)
@@ -208,14 +209,31 @@ def _decode_maybe_compressed(data: bytes, content_type: str) -> list[str]:
 
 @web.middleware
 async def error_middleware(request: web.Request, handler):
-    """OryxServingException → HTTP status (OryxExceptionMapper)."""
+    """OryxServingException → HTTP status (OryxExceptionMapper). Shed
+    requests (OverloadedException) additionally carry a ``Retry-After``
+    hint; an expired request deadline maps to 504 with the partial trace
+    id, so the operator can pull up exactly how far the request got."""
     try:
         return await handler(request)
     except OryxServingException as e:
+        headers = {}
+        retry_after = getattr(e, "retry_after_sec", None)
+        if retry_after is not None:
+            headers["Retry-After"] = str(max(1, int(retry_after)))
         accept = request.headers.get("Accept", "")
         if "text/csv" in accept:
-            return web.Response(text=e.message, status=e.status, content_type="text/plain")
-        return web.json_response({"error": e.message, "status": e.status}, status=e.status)
+            return web.Response(text=e.message, status=e.status,
+                                content_type="text/plain", headers=headers)
+        return web.json_response({"error": e.message, "status": e.status},
+                                 status=e.status, headers=headers)
+    except resilience.DeadlineExceeded as e:
+        return web.json_response({
+            "error": str(e) or "request deadline exceeded",
+            "status": 504,
+            # the PARTIAL trace: every span recorded before the budget ran
+            # out is already in the ring, retrievable by this id
+            "trace_id": spans.current_trace_id(),
+        }, status=504)
     except web.HTTPException:
         raise
     except Exception as e:  # noqa: BLE001 - uniform 500 mapping

@@ -52,9 +52,14 @@ async def _top_n(request, model, vec, how_many, offset, allowed, rescore,
                  excluded):
     """Recommend-family top-N: coalesced into one batched device call with
     concurrent requests when no score-rewriting rescorer applies (a shared
-    scan cannot honor per-request rescore hooks)."""
+    scan cannot honor per-request rescore hooks).
+
+    Degraded mode: while the device-call circuit breaker is OPEN
+    (``coalescer.admit()`` false), requests bypass the coalescer and run
+    per-request scans on the current model — slower, but answering — until
+    a half-open probe through the coalesced path closes the breaker."""
     coalescer = request.app.get(rsrc.COALESCER_KEY)
-    if coalescer is not None and rescore is None:
+    if coalescer is not None and rescore is None and coalescer.admit():
         return await coalescer.top_n(model, vec, how_many, offset, allowed,
                                      excluded)
     return await _run(

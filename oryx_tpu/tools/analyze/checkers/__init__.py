@@ -8,6 +8,7 @@ from oryx_tpu.tools.analyze.checkers.locks import LockDisciplineChecker
 from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
 from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
 from oryx_tpu.tools.analyze.checkers.logstyle import LogDisciplineChecker
+from oryx_tpu.tools.analyze.checkers.swallowed import SwallowedExceptionChecker
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -18,4 +19,5 @@ ALL_CHECKERS = (
     ConfigKeyDriftChecker(),
     Float64PromotionChecker(),
     LogDisciplineChecker(),
+    SwallowedExceptionChecker(),
 )

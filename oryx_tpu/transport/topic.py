@@ -45,8 +45,10 @@ from pathlib import Path
 from typing import Iterator
 
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import faults
 from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 
 log = spans.get_logger(__name__)
@@ -69,7 +71,22 @@ _CONSUMED = metrics_mod.default_registry().counter(
 
 
 class TopicException(Exception):
-    pass
+    """Transport-level failure. ``transient=True`` marks conditions a retry
+    can reasonably outlast (broker briefly unreachable); the default False
+    covers the permanent ones (topic missing, oversized message)."""
+
+    def __init__(self, *args, transient: bool = False):
+        super().__init__(*args)
+        self.transient = transient
+
+
+def transient_transport_error(exc: BaseException) -> bool:
+    """The transport retry predicate: I/O errors (shared-FS hiccups under
+    the ``file:`` broker, injected faults) and explicitly-transient
+    TopicExceptions. Missing topics and oversize sends stay fatal."""
+    if isinstance(exc, TopicException):
+        return exc.transient
+    return isinstance(exc, OSError)
 
 
 #: Seconds after which a consumer-group member with no heartbeat is dropped
@@ -467,9 +484,9 @@ class FileBroker(Broker):
         with open(p, "rb") as f:
             f.seek(idx[cut])
             rest = f.read()
-        tmp = p.with_suffix(".tmp")
-        tmp.write_bytes(rest)
-        tmp.replace(p)
+        # atomic rename (unique temp + fsync): a retention pass killed
+        # mid-rewrite must never leave a truncated half-log behind
+        ioutils.atomic_write_bytes(p, rest)
         with self._lock:
             self._index.pop((topic, partition), None)
 
@@ -485,11 +502,13 @@ class FileBroker(Broker):
         return json.loads(p.read_text())["offset"]
 
     def set_offset(self, group: str, topic: str, offset: int, partition: int = 0) -> None:
+        # write-temp + fsync + os.replace (unique temp name): a replica
+        # killed mid-commit leaves the old offset intact, never a torn JSON
+        # that would corrupt resume positions for the whole group — and two
+        # concurrent committers cannot interleave bytes in one temp file
         p = self._offset_path(group, topic, partition)
         ioutils.mkdirs(p.parent)
-        tmp = p.with_suffix(".tmp")
-        tmp.write_text(json.dumps({"offset": offset}))
-        tmp.replace(p)
+        ioutils.atomic_write_text(p, json.dumps({"offset": offset}))
 
     def _group_dir(self, group: str, topic: str) -> Path:
         return self._root / ".groups" / f"{group}__{topic}"
@@ -537,6 +556,9 @@ class TopicProducerImpl:
         self._topic = topic
         self._max_size = max_size
         self._broker: Broker | None = None  # lazy, like the reference
+        # set by close(): aborts an in-flight send's retry backoff sleeps so
+        # teardown never waits out the retry budget against a dead broker
+        self._closed = threading.Event()
 
     def get_update_broker(self) -> str:
         return self._broker_url
@@ -547,22 +569,35 @@ class TopicProducerImpl:
     def send(self, key, message, headers: "dict | None" = None) -> None:
         if self._broker is None:
             self._broker = get_broker(self._broker_url)
+            self._closed.clear()  # a send after close() reopens (lazy, as ever)
         # trace propagation: the producer injects the caller's current span
         # as a traceparent header (W3C format), so a trace minted at HTTP
         # ingress crosses the topic hop into whichever tier consumes this
         headers = spans.inject_headers(headers)
+
+        def _append():
+            faults.maybe_fail("broker.append")
+            self._broker.append(self._topic, key, message, headers)
+
         try:
             if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
                 raise TopicException(
                     f"message of {len(message)} bytes exceeds max {self._max_size}"
                 )
-            self._broker.append(self._topic, key, message, headers)
+            # transient append failures (file-broker I/O, injected faults)
+            # retry under the process policy; a send raises only once the
+            # budget is spent — retries are visible in oryx_retries_total
+            resilience.default_policy().call(
+                "broker.append", _append, retryable=transient_transport_error,
+                stop=self._closed,
+            )
         except Exception:
             _SEND_FAILURES.labels(self._topic).inc()
             raise
         _PRODUCED.labels(self._topic).inc()
 
     def close(self) -> None:
+        self._closed.set()
         self._broker = None
 
 
@@ -648,6 +683,20 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
     def _offset_of(self, partition: int) -> int:
         return self._offsets.setdefault(partition, 0)
 
+    def _read_with_retry(self, partition: int, offset: int) -> list:
+        """One partition poll, retried through transient broker failures
+        (stop-aware: a close() mid-backoff aborts the sleep). Exhausting the
+        budget raises out of the consumer — supervised consumers restart."""
+
+        def _read():
+            faults.maybe_fail("broker.read")
+            return self._broker.read(self._topic, offset, partition=partition)
+
+        return resilience.default_policy().call(
+            "broker.read", _read, retryable=transient_transport_error,
+            stop=self._closed,
+        )
+
     @property
     def offset(self) -> int:
         """Single-partition position (back-compat for 1-partition topics)."""
@@ -668,7 +717,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             progressed = False
             for p in self._assigned():
                 off = self._offset_of(p)
-                batch = self._broker.read(self._topic, off, partition=p)
+                batch = self._read_with_retry(p, off)
                 if batch:
                     self._offsets[p] = off + len(batch)
                     self._buffer.extend(
@@ -694,7 +743,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             try:
                 self._broker.leave_group(self._group, self._topic, self._member_id)
             except Exception:  # noqa: BLE001 — best-effort on teardown
-                pass
+                log.debug("leave_group failed on close", exc_info=True)
         self._broker.wake(self._topic)
 
 

@@ -1,0 +1,236 @@
+"""Chaos e2e: a LIVE serving+speed pair under scheduled fault injection.
+
+The acceptance scenario of the resilience subsystem (docs/robustness.md):
+with ``oryx.faults`` driving exact failure schedules through the real code
+paths — broker appends failing 3-then-succeeding, the update consumer
+crashing once, the coalesced device call failing past the breaker
+threshold — the serving layer must keep answering (degraded where needed),
+recover without operator action, and a post-disarm warm window must show
+zero request errors and zero sheds.
+
+Tests run IN ORDER against one shared pair (tier-1 runs with -p
+no:randomly); each phase arms its own schedule and disarms after itself.
+"""
+
+import concurrent.futures as cf
+import time
+
+import httpx
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import faults
+from oryx_tpu.common import ioutils
+from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+
+def _counter(name: str, label: str = "") -> float:
+    snap = metrics_mod.default_registry().snapshot()
+    return snap.get(name, {}).get(label, 0.0)
+
+
+def _metric_line(text: str, name: str, label_frag: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name) and label_frag in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+@pytest.fixture(scope="module")
+def chaos_pair(tmp_path_factory):
+    from tests.test_serving import _publish_to_topic, _train_tiny
+
+    tp.reset_memory_brokers()
+    faults.disarm()
+    tmp_path = tmp_path_factory.mktemp("chaos-model")
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "chaos-e2e",
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.speed.model-manager-class": "tests.test_lambda.MockSpeedManager",
+            "oryx.speed.streaming.config.platform": "cpu",
+            # chaos-tuned shapes: fast retries, a breaker that opens after 2
+            # failures and probes every 300ms, fast consumer resurrection
+            "oryx.resilience.retry.base-delay-ms": 2,
+            "oryx.resilience.retry.max-delay-ms": 20,
+            "oryx.resilience.breaker.failure-threshold": 2,
+            "oryx.resilience.breaker.reset-sec": 0.3,
+            "oryx.resilience.consumer-restart.base-delay-ms": 20,
+            "oryx.resilience.consumer-restart.max-delay-ms": 100,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    pmml, batch, known = _train_tiny(tmp_path)
+    _publish_to_topic(pmml, tmp_path, known)
+
+    from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+    serving = ServingLayer(config)
+    serving.start()
+    # the speed tier shares the INPUT topic but publishes to its own update
+    # topic (its mock "count,N" messages are not ALS updates)
+    speed_config = cfg.overlay_on(
+        {"oryx.update-topic.message.topic": "OryxUpdateSpeed"}, config
+    )
+    tp.maybe_create_topics(speed_config, "update-topic")
+    speed = SpeedLayer(speed_config)
+    speed.start(interval_sec=0.2)
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=60)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get("/ready").status_code == 200:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("serving layer never became ready")
+    user = batch.users.index_to_id[0]
+    yield client, serving, speed, user
+    faults.disarm()
+    client.close()
+    speed.close()
+    serving.close()
+    tp.reset_memory_brokers()
+
+
+def test_chaos_broker_faults_drop_no_inflight_requests(chaos_pair):
+    """broker.append fail-3-then-succeed under concurrent writes: the retry
+    policy absorbs every injected failure — zero client-visible errors."""
+    client, serving, speed, user = chaos_pair
+    base = str(client.base_url)
+    recovered_before = _counter(
+        "oryx_retries_total", 'site="broker.append",outcome="recovered"'
+    )
+    faults.arm("broker.append=fail:3", seed=7)
+    try:
+        def post(i):
+            with httpx.Client(base_url=base, timeout=60) as c:
+                return c.post(f"/pref/uChaos{i}/iChaos{i}", content="1.0").status_code
+
+        with cf.ThreadPoolExecutor(12) as pool:
+            statuses = list(pool.map(post, range(12)))
+        assert statuses == [200] * 12, statuses
+        # the schedule really fired through the real append path...
+        stats = faults.stats()["broker.append"]
+        assert stats["injected"] == 3, stats
+    finally:
+        faults.disarm()
+    # ...and the retries that absorbed it are visible in /metrics
+    assert _counter(
+        "oryx_retries_total", 'site="broker.append",outcome="recovered"'
+    ) - recovered_before >= 1
+    # both layers are still alive and well
+    assert not speed.stopped
+    assert client.get("/readyz").status_code == 200
+
+
+def test_chaos_update_consumer_crash_restarts_within_budget(chaos_pair):
+    """One injected consumer crash: the supervised loop restarts it (replay
+    from earliest), /readyz recovers, and the HTTP side keeps serving from
+    the in-memory model the whole time."""
+    client, serving, speed, user = chaos_pair
+    restarts_before = serving.consumer_restarts
+    metric_before = _counter("oryx_serving_consumer_restarts_total")
+    faults.arm("serving.update_consume=fail:1", seed=0)
+    try:
+        # wake the consumer with a fresh (ignorable) update — the fault
+        # fires on its next __next__, crashing manager.consume
+        tp.TopicProducerImpl("memory:", "OryxUpdate").send(
+            "UP", '["Y", "chaos-item", [0.0, 0.0, 0.0, 0.0]]'
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            # stale-model degraded mode: requests answer THROUGHOUT
+            assert client.get(f"/recommend/{user}").status_code == 200
+            if serving.consumer_restarts > restarts_before:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("update consumer never restarted")
+    finally:
+        faults.disarm()
+    assert _counter("oryx_serving_consumer_restarts_total") - metric_before >= 1
+    # replay from earliest re-delivered the model: readiness recovers
+    # without operator action
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if client.get("/readyz").status_code == 200:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("/readyz never recovered after the consumer restart")
+    assert client.get(f"/recommend/{user}").status_code == 200
+
+
+def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
+    """Device-call failures past the threshold: requests NEVER error (the
+    failed batch retries per-request, open-breaker traffic degrades to
+    uncoalesced scans), and open → half_open → closed is observable in
+    GET /metrics."""
+    client, serving, speed, user = chaos_pair
+    degraded_before = _counter("oryx_breaker_degraded_requests_total")
+    faults.arm("serving.device_call=fail:2", seed=0)
+    try:
+        # two sequential requests = two coalesced device calls = two
+        # injected failures -> breaker (threshold 2) opens; both requests
+        # still answer via the per-request fallback
+        for _ in range(2):
+            r = client.get(f"/recommend/{user}")
+            assert r.status_code == 200 and len(r.json()) == 10
+        text = client.get("/metrics").text
+        assert _metric_line(
+            text, "oryx_circuit_breaker_state", 'breaker="serving.device_call"'
+        ) == 1.0, "breaker did not open after threshold failures"
+        # open-breaker traffic: still 200, via the degraded path
+        r = client.get(f"/recommend/{user}")
+        assert r.status_code == 200
+        assert _counter("oryx_breaker_degraded_requests_total") > degraded_before
+        # after reset-sec a probe goes through the (now healthy) coalesced
+        # path and closes the breaker
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            time.sleep(0.15)
+            assert client.get(f"/recommend/{user}").status_code == 200
+            text = client.get("/metrics").text
+            if _metric_line(
+                text, "oryx_circuit_breaker_state",
+                'breaker="serving.device_call"'
+            ) == 0.0:
+                break
+        else:
+            pytest.fail("breaker never re-closed after faults cleared")
+    finally:
+        faults.disarm()
+    # the full cycle is in the transitions counter
+    text = client.get("/metrics").text
+    for target in ("open", "half_open", "closed"):
+        assert _metric_line(
+            text, "oryx_circuit_breaker_transitions_total",
+            f'breaker="serving.device_call",to="{target}"',
+        ) >= 1.0, f"no {target} transition recorded"
+
+
+def test_chaos_warm_window_clean_after_disarm(chaos_pair):
+    """Faults disarmed: a warm window of concurrent traffic records zero
+    request errors and zero sheds (the recovered steady state)."""
+    client, serving, speed, user = chaos_pair
+    faults.disarm()
+    base = str(client.base_url)
+    shed_before = _counter("oryx_shed_requests_total")
+
+    def get(i):
+        with httpx.Client(base_url=base, timeout=60) as c:
+            return c.get(f"/recommend/{user}").status_code
+
+    with cf.ThreadPoolExecutor(8) as pool:
+        statuses = list(pool.map(get, range(48)))
+    assert statuses == [200] * 48, sorted(set(statuses))
+    assert _counter("oryx_shed_requests_total") - shed_before == 0
+    assert client.get("/readyz").status_code == 200

@@ -500,6 +500,77 @@ def test_log_discipline_quiet_outside_hot_paths_and_on_adapter():
 
 
 # ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+
+def test_swallowed_exception_fires_on_silent_broad_catches():
+    src = """
+        def handler():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def teardown():
+            try:
+                close()
+            except:
+                result = None
+            return result
+    """
+    hits = _run(src, "swallowed-exception",
+                filename="oryx_tpu/serving/fixture.py")
+    assert len(hits) == 2
+    assert all("degrade LOUDLY" in f.message for f in hits)
+
+
+def test_swallowed_exception_quiet_on_narrow_logged_or_reraised():
+    src = """
+        from oryx_tpu.common import spans
+
+        log = spans.get_logger(__name__)
+
+        def narrow():
+            try:
+                work()
+            except FileNotFoundError:
+                pass  # narrow catch: deliberate control flow
+
+        def logged():
+            try:
+                work()
+            except Exception:
+                log.exception("work failed")
+
+        def reraised():
+            try:
+                work()
+            except Exception as e:
+                failures.inc()
+                raise
+
+        def recorded(span):
+            try:
+                work()
+            except Exception as e:
+                span.record_exception(e)
+    """
+    assert _run(src, "swallowed-exception",
+                filename="oryx_tpu/transport/fixture.py") == []
+    # identical silent swallow is fine OUTSIDE the hot-path tiers
+    silent = """
+        def cli():
+            try:
+                work()
+            except Exception:
+                pass
+    """
+    assert _run(silent, "swallowed-exception",
+                filename="oryx_tpu/tools/fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
