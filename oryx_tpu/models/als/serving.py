@@ -83,6 +83,17 @@ def _round_up_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+#: Floor of the pow2-bucketed exclusion-mask width. Known-item exclusion is
+#: what the DEFAULT /recommend path sends (considerKnownItems=false), so its
+#: jit signature must be shape-stable enough to PRE-warm: flooring the width
+#: means every request with ≤ this many known items — the overwhelming
+#: common case — lands on ONE compiled program, which the batch warmer
+#: compiles off-path (warm_bucket). Users past the floor bucket up by pow2
+#: and pay one compile per bucket per process (persistent-cache-served
+#: afterwards), exactly like unusual howMany values.
+_EXCL_PAD_MIN = 8
+
+
 def _score(qs, mat):
     """(B, n) scores with f32 accumulation. ``mat`` may be bfloat16 (the MXU's
     native input dtype — half the HBM traffic of f32); accumulation stays f32
@@ -447,8 +458,9 @@ class ALSServingModel(ServingModel):
     # -- query primitives ----------------------------------------------------
     @staticmethod
     def _excluded_indices(snap: _YSnapshot, excluded, batch: int) -> np.ndarray:
-        """(B, E) int32 of global Y rows to mask out, -1-padded, E a pow2 so
-        jit signatures stay stable across requests."""
+        """(B, E) int32 of global Y rows to mask out, -1-padded, E a pow2
+        FLOORED at ``_EXCL_PAD_MIN`` so the common exclusion widths all
+        share one jit signature — the one the batch warmer precompiles."""
         idx_lists: list[list[int]] = []
         max_e = 1
         for b in range(batch):
@@ -460,7 +472,8 @@ class ALSServingModel(ServingModel):
             )
             idx_lists.append(ix)
             max_e = max(max_e, len(ix))
-        out = np.full((batch, _round_up_pow2(max_e)), -1, dtype=np.int32)
+        width = max(_EXCL_PAD_MIN, _round_up_pow2(max_e))
+        out = np.full((batch, width), -1, dtype=np.int32)
         for b, ix in enumerate(idx_lists):
             out[b, : len(ix)] = ix
         return out
@@ -652,7 +665,14 @@ class ALSServingModel(ServingModel):
         entirely), then one real zero-batch execution to populate the jit
         dispatch cache the request path actually hits and to materialize
         the device-resident factor snapshot. Raises when the model has no
-        items yet (the warmer retries later)."""
+        items yet (the warmer retries later).
+
+        BOTH signature families warm: exclusion-free AND exclusion-carrying
+        — the default ``/recommend`` path (considerKnownItems=false) always
+        sends known-item exclusions, and ``_excluded_indices`` pads them to
+        the shape-stable ``_EXCL_PAD_MIN`` width this warms, so the first
+        client burst after a MODEL handoff pays no compile on the endpoint
+        it actually calls."""
         import jax
 
         snap = self.y_snapshot()
@@ -661,14 +681,21 @@ class ALSServingModel(ServingModel):
         qs_struct = jax.ShapeDtypeStruct(
             (batch_size, self.features), jnp.float32
         )
+        excl_struct = jax.ShapeDtypeStruct(
+            (batch_size, _EXCL_PAD_MIN), jnp.int32
+        )
         if snap.sharded_mat is not None:
             # the sharded scan builds its program through the lru-cached
-            # _sharded_top_k_fn; the execution below compiles it off-path
+            # _sharded_top_k_fn; the executions below compile it off-path
             pass
         elif self.lsh is None or snap.buckets is None:
             k = min(snap.n, _round_up_pow2(max(how_many, 16)))
             compilecache.aot_compile(
                 _top_k_dot_batch, snap.score_mat, qs_struct, None, None, k
+            )
+            compilecache.aot_compile(
+                _top_k_dot_batch, snap.score_mat, qs_struct, None,
+                excl_struct, k
             )
         else:
             k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
@@ -679,8 +706,18 @@ class ALSServingModel(ServingModel):
                 _top_k_dot_batch_masked, snap.score_mat, qs_struct,
                 lut_struct, snap.buckets, None, k
             )
+            compilecache.aot_compile(
+                _top_k_dot_batch_masked, snap.score_mat, qs_struct,
+                lut_struct, snap.buckets, excl_struct, k
+            )
+        zeros = np.zeros((batch_size, self.features), dtype=np.float32)
+        self.top_n_batch(zeros, how_many)
+        # one real exclusion-carrying execution: an id no snapshot contains
+        # maps to an all(-1) mask of the floored width — the exact program
+        # the default endpoint's known-item exclusions dispatch to
         self.top_n_batch(
-            np.zeros((batch_size, self.features), dtype=np.float32), how_many
+            zeros, how_many,
+            excluded=[("__warm__",)] + [None] * (batch_size - 1),
         )
 
     def top_n_cosine(

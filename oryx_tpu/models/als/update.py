@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from pathlib import Path
 from typing import Sequence
@@ -50,6 +51,16 @@ class ALSUpdate(MLUpdate):
         ]
         if self.log_strength:
             self.hyper_params.append(hp.from_config(config, "oryx.als.hyperparams.epsilon"))
+        # slotted-layout reuse across generations: when the next
+        # generation's COO extends this one's (append-mostly input and no
+        # decay rewriting historical strengths), the host pack collapses to
+        # an incremental delta of the touched blocks instead of a full
+        # re-sort of every interaction ever seen. One cache per updater
+        # (generations build sequentially on the batch tier); concurrent
+        # hyperparameter candidates contend on the try-lock and simply pack
+        # uncached rather than interleave the cache's generations.
+        self._layout_cache = als_train_mod.BlockedLayoutCache()
+        self._layout_cache_lock = threading.Lock()
 
     def get_hyper_parameter_values(self):
         return list(self.hyper_params)
@@ -79,17 +90,35 @@ class ALSUpdate(MLUpdate):
         ctx_mesh = getattr(context, "mesh", None)
         if ctx_mesh is not None and ctx_mesh.size > 1 and "model" in ctx_mesh.axis_names:
             mesh, row_axis = ctx_mesh, "model"
-        x, y = als_train_mod.als_train(
-            batch,
-            features=features,
-            lam=lam,
-            alpha=alpha,
-            implicit=self.implicit,
-            iterations=self.iterations,
-            key=rand.get_key(),
-            mesh=mesh,
-            row_axis=row_axis,
-            dtype=self.compute_dtype,
+        cache = (
+            self._layout_cache
+            if self._layout_cache_lock.acquire(blocking=False) else None
+        )
+        timings: dict = {}
+        try:
+            x, y = als_train_mod.als_train(
+                batch,
+                features=features,
+                lam=lam,
+                alpha=alpha,
+                implicit=self.implicit,
+                iterations=self.iterations,
+                key=rand.get_key(),
+                mesh=mesh,
+                row_axis=row_axis,
+                dtype=self.compute_dtype,
+                layout_cache=cache,
+                timings=timings,
+            )
+        finally:
+            if cache is not None:
+                self._layout_cache_lock.release()
+        log.info(
+            "ALS train: %d nnz, pack %.2fs on the critical path (user %.2fs"
+            " + item wait %.2fs; modes %s)",
+            batch.nnz, timings.get("pack_s", 0.0),
+            timings.get("pack_user_s", 0.0), timings.get("pack_wait_s", 0.0),
+            timings.get("pack_modes"),
         )
         # mesh-path factors come back row-partitioned and padded to the block
         # boundary (train.als_train contract) — slice to exact size host-side

@@ -16,6 +16,31 @@ Gramians and one write of the solutions:
 No pivoting: operands are regularized SPD (diagonal shift λ·n ≥ λ), for
 which diagonal pivots are bounded away from zero.
 
+``gather_gramian_accumulate`` fuses the ALS trainer's entire Gramian
+accumulation — the opposite-factor gather, the per-slot (k, k) Gramian/RHS
+contraction, and the slot→row merge — into one pass over the slotted COO
+(train._solve_block). The XLA formulation materializes the (Sc, T, k)
+``y[cs]`` gather in HBM, streams it back for the einsum, writes the
+(Sc, k, k) per-slot Gramians, and streams THOSE back through segment_sum —
+three HBM round-trips per scan chunk while the MXU idles (measured MFU
+0.15%: the loop is gather-bandwidth-bound, and bf16 inputs buy only 17%).
+Here each factor row crosses HBM exactly once:
+
+  grid = slots; per step:  DMA-gather the slot's T factor rows → VMEM
+                           (rows are column-sorted within the slot, so the
+                           gather walks HBM in address order; ring of
+                           ``_GG_BUFS`` in-flight copies)
+                           Gramian (k,T)·(T,k) + RHS (1,T)·(T,k)   (MXU)
+                           accumulate into the slot's OWNER ROW's
+                           (1, k, k)/(1, k) output block in VMEM
+
+Slots arrive row-sorted (the pack guarantees it), so the per-row output
+block — selected by a scalar-prefetched ``srow`` index map — is revisited
+across every slot of a row and flushed to HBM once per row, replacing the
+whole segment-sum pass. Rows with no slots keep the donated zero input
+(``input_output_aliases``), which also makes never-visited blocks
+deterministic under interpret mode.
+
 ``kmeans_assign_accumulate`` fuses one full Lloyd-sweep accumulation —
 squared-distance evaluation, nearest-center argmin, and weighted
 sum/count/cost accumulation — into a single pass over point tiles. The
@@ -150,6 +175,157 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
                             axis=0)
     x = _spd_solve_call(a, b, tile_b=tile_b, interpret=bool(interpret))
     return x[:n]
+
+
+# in-flight DMA ring depth for the per-slot factor-row gather: deep enough
+# to hide one row's HBM latency behind the previous rows' copies, shallow
+# enough that the semaphore array stays trivially within hardware limits
+_GG_BUFS = 4
+# features past this would push the (k, k) output block + (T, k) gather
+# scratch toward the scoped-VMEM budget; callers fall back to the einsum
+# formulation (same numerics, more HBM traffic)
+_GG_MAX_FEATURES = 256
+
+
+def gather_gramian_supported(features: int) -> bool:
+    """Whether the fused gather-Gramian kernel fits its VMEM budget."""
+    return features <= _GG_MAX_FEATURES
+
+
+def _make_gather_gramian_kernel(t: int, k: int):
+    def kernel(srow_ref, scols_ref, slens_ref, w_ref, coef_ref, y_ref,
+               a0_ref, b0_ref, a_ref, b_ref, yg, sems):
+        i = pl.program_id(0)
+        row = srow_ref[i]
+        prev_row = srow_ref[jnp.maximum(i - 1, 0)]
+
+        # first slot of a new output row: the (1, k, k)/(1, k) blocks just
+        # rotated in (their VMEM content is undefined) — zero before the
+        # first accumulation. Slots are row-sorted, so a row's block stays
+        # resident for all of its slots and flushes to HBM exactly once.
+        @pl.when(jnp.logical_or(i == 0, prev_row != row))
+        def _():
+            a_ref[:] = jnp.zeros_like(a_ref)
+            b_ref[:] = jnp.zeros_like(b_ref)
+
+        ls = slens_ref[0, 0]
+
+        # pad slots (no valid entries) skip the gather AND the matmuls:
+        # their owner is the spill row, initialized above and sliced off by
+        # the caller — issuing T DMAs of row 0 for them would only burn
+        # bandwidth
+        @pl.when(ls > 0)
+        def _():
+            def dma(tt):
+                # one factor row per copy; within a slot the column indices
+                # are ascending (pack sorts by (row, col)), so consecutive
+                # copies walk y in HBM address order
+                return pltpu.make_async_copy(
+                    y_ref.at[scols_ref[0, tt]], yg.at[tt],
+                    sems.at[tt % _GG_BUFS],
+                )
+
+            for tt in range(min(_GG_BUFS, t)):
+                dma(tt).start()
+
+            def body(tt, carry):
+                # wait BEFORE reusing the slot's semaphore: copy tt+BUFS
+                # signals sems[tt % BUFS] too, and a counting semaphore
+                # can't tell whose bytes released the wait — issuing it
+                # first would let a faster tt+BUFS copy satisfy this wait
+                # while row tt is still in flight
+                dma(tt).wait()
+
+                @pl.when(tt + _GG_BUFS < t)
+                def _():
+                    dma(tt + _GG_BUFS).start()
+
+                return carry
+
+            jax.lax.fori_loop(0, t, body, 0, unroll=True)
+
+            ygv = yg[:]  # (T, k), y's dtype (bf16 = MXU-native inputs)
+            cd = ygv.dtype
+            # per-entry weights arrive precomputed (confidence/mask algebra
+            # is cheap VPU work best left to XLA); cast to the gather dtype
+            # so bf16 inputs hit the MXU's bf16×bf16→f32 path like the
+            # einsum formulation does
+            wcol = w_ref[:].reshape(t, 1).astype(cd)
+            ga = jax.lax.dot_general(
+                ygv * wcol, ygv, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (k, k): sum_t w_t · y_t ⊗ y_t
+            gb = jnp.dot(coef_ref[:].astype(cd), ygv,
+                         preferred_element_type=jnp.float32)  # (1, k)
+            a_ref[:] = a_ref[:] + ga[None]
+            b_ref[:] = b_ref[:] + gb
+
+    return kernel
+
+
+def gather_gramian_accumulate(y, srow, scols, w, coef, slens, *, block: int,
+                              interpret: bool):
+    """Fused gather → per-slot Gramian → per-row accumulate for one block.
+
+    Args:
+      y: (R, k) opposite-side factors (f32 or bf16), HBM-resident.
+      srow: (S,) int32 block-local owner row per slot, SORTED ascending,
+        pad = ``block`` (the spill row).
+      scols: (S, T) int32 gather indices into ``y`` (column-ascending
+        within each slot).
+      w / coef: (S, T) f32 per-entry Gramian / RHS weights, zero on padding
+        entries (the mask and confidence algebra are applied by the caller).
+      slens: (S,) int32 valid entries per slot (0 = pad slot).
+      block: rows per block; outputs carry the extra spill row.
+
+    Returns (big_a (block+1, k, k) f32, big_b (block+1, k) f32). Rows with
+    no slots return exact zeros (donated zero inputs).
+    """
+    s, t = scols.shape
+    k = y.shape[1]
+    a0 = jnp.zeros((block + 1, k, k), jnp.float32)
+    b0 = jnp.zeros((block + 1, k), jnp.float32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # srow drives the output index maps
+        grid=(s,),
+        in_specs=[
+            # gather indices + lengths are scalars (DMA addresses / loop
+            # bounds): SMEM, one slot per grid step
+            pl.BlockSpec((1, t), lambda i, sr: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, sr: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, t), lambda i, sr: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t), lambda i, sr: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # y stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # big_a zero donor
+            pl.BlockSpec(memory_space=pltpu.ANY),  # big_b zero donor
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, k), lambda i, sr: (sr[i], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i, sr: (sr[i], 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((t, k), y.dtype),  # gathered factor rows
+            pltpu.SemaphoreType.DMA((_GG_BUFS,)),
+        ],
+    )
+    return pl.pallas_call(
+        _make_gather_gramian_kernel(t, k),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((block + 1, k, k), jnp.float32),
+            jax.ShapeDtypeStruct((block + 1, k), jnp.float32),
+        ],
+        # zero donors alias the outputs: rows no slot ever visits keep
+        # exact zeros — deterministic on hardware AND under interpret
+        input_output_aliases={6: 0, 7: 1},
+        interpret=interpret,
+    )(srow, scols, slens.reshape(s, 1), w, coef, y, a0, b0)
 
 
 def _kernel(points_ref, weights_ref, centers_ref, sums_ref, counts_ref, cost_ref):

@@ -300,8 +300,25 @@ class TopNCoalescer:
                     "queue_wait_ms", round((now - p.enq_t) * 1000.0, 3)
                 )
                 spans.finish_span(p.wait_span)
-            loop.run_in_executor(None, self._execute, loop, model, group,
-                                 call_span)
+            try:
+                loop.run_in_executor(None, self._execute, loop, model, group,
+                                     call_span)
+            except Exception as e:  # noqa: BLE001 — executor/loop torn down
+                # dispatch itself failed (executor shut down mid-close): the
+                # slot was taken but _execute will never run, so _done will
+                # never release it — undo the increment HERE and fail the
+                # group's futures instead of leaving them (and every later
+                # pending request behind the leaked slot) to hang until
+                # client timeout
+                self._inflight -= 1
+                call_span.record_exception(e)
+                spans.finish_span(call_span)
+                log.exception(
+                    "coalesced dispatch failed before execution; failing "
+                    "its %d request(s)", len(group),
+                )
+                for p in group:
+                    _set_exception(p.future, e)
         for model, group in reversed(groups):
             self._pending[:0] = [(model, p) for p in group]
         _QUEUE_DEPTH.set(len(self._pending))

@@ -20,6 +20,11 @@ Usage:
     python -m oryx_tpu.tools.trace_summary <metrics-dump-or-url> [--metrics]
     python -m oryx_tpu.tools.trace_summary <server-url-or-trace-json> \
         --trace-id <32-hex id>
+    python -m oryx_tpu.tools.trace_summary <bench-batch-json> --batch
+
+``--batch`` renders a ``bench_batch.py`` record: throughput/MFU per input
+precision, the fused-vs-unfused Gramian split, the gather/einsum/scatter/
+solve phase attribution, and the pack-overlap evidence per generation.
 
 A ``http(s)://`` argument is always fetched and read as a metrics dump
 (append ``/metrics`` yourself if you pass the bare server root); a file is
@@ -409,13 +414,68 @@ def _read_metrics_arg(path: str) -> str:
         return fh.read()
 
 
+def render_batch_record(payload: dict, out=None) -> int:
+    """Render a ``bench_batch.py`` JSON record: throughput/MFU headline,
+    the fused-vs-unfused Gramian split, the phase wall-time attribution
+    (gather / einsum / scatter / solve — the docs/performance.md "Trainer
+    roofline" inputs), and the pack-overlap evidence per generation."""
+    out = out or sys.stdout
+    w = out.write
+    rec = payload.get("batch", payload)  # accept a bench.py wrapper too
+    unit = rec.get("unit", "ratings/s")
+    w(f"{rec.get('metric', 'als batch train')}  [{rec.get('backend', '?')}"
+      f" / {rec.get('device_kind', '?')}]\n")
+    rows = [("f32" + (" (fused)" if rec.get("fused_gramian") else ""), rec)]
+    if "unfused_f32" in rec:
+        rows.append(("f32 (unfused)", rec["unfused_f32"]))
+    if "bf16" in rec:
+        rows.append(("bf16", rec["bf16"]))
+    for name, r in rows:
+        if not isinstance(r, dict) or "value" not in r:
+            continue
+        mfu = f"  mfu={r['mfu']:.4f}" if "mfu" in r else ""
+        w(f"  {name:<16} {r['value']:>14,.0f} {unit}"
+          f"  ({r.get('useful_tflops_per_s', 0)} TF/s{mfu})\n")
+    if rec.get("fused_speedup"):
+        w(f"  fused speedup: {rec['fused_speedup']}x over the einsum "
+          f"formulation\n")
+    split = rec.get("phase_split")
+    if split:
+        total = split.get("half_iteration_s") or sum(
+            v for k, v in split.items() if k.endswith("_s")
+        ) or 1.0
+        w("phase split (one unfused half-iteration):\n")
+        for phase in ("gather", "einsum", "scatter", "solve"):
+            v = split.get(f"{phase}_s")
+            if v is None:
+                continue
+            w(f"  {phase:<8} {v:8.3f}s  {100.0 * v / total:5.1f}%\n")
+    e2e = rec.get("train_e2e")
+    if e2e:
+        w("pack/compute overlap (als_train end-to-end):\n")
+        for gen, g in e2e.items():
+            modes = g.get("pack_modes") or {}
+            # pack_lt_elapsed is the STRICT form: critical-path pack under
+            # the REMAINING (device) wall, elapsed_s - pack_s
+            verdict = ("pack < device wall" if g.get("pack_lt_elapsed")
+                       else "pack >= device wall")
+            w(f"  {gen}: elapsed {g.get('elapsed_s')}s, pack on critical "
+              f"path {g.get('pack_s')}s ({verdict}; "
+              f"user={modes.get('user', '?')}, item={modes.get('item', '?')})\n")
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     top = 15
     track_filter = None
     force_metrics = False
+    force_batch = False
     trace_id = None
     try:
+        if "--batch" in args:
+            force_batch = True
+            args.remove("--batch")
         if "--top" in args:
             i = args.index("--top")
             top = int(args[i + 1])
@@ -437,6 +497,9 @@ def main(argv: "list[str] | None" = None) -> int:
         print(__doc__, file=sys.stderr)
         return 2
     path = args[0]
+    if force_batch:
+        # file or URL, like every other argument form in this tool
+        return render_batch_record(json.loads(_read_metrics_arg(path)))
     if trace_id is not None:
         return render_span_tree(_fetch_trace(path, trace_id))
     if path.startswith(("http://", "https://")) or force_metrics:

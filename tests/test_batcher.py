@@ -388,3 +388,37 @@ def test_http_concurrent_recommends_share_device_calls(monkeypatch, tmp_path):
     finally:
         layer.close()
         tp.reset_memory_brokers()
+
+
+def test_dispatch_failure_releases_inflight_and_fails_futures():
+    """run_in_executor raising at dispatch (executor/loop shut down
+    mid-close) must release the _inflight slot and fail the group's futures
+    — before the fix the slot leaked forever and every pending request
+    behind it hung until client timeout (ADVICE r5)."""
+    model = _CountingModel()
+    coal = TopNCoalescer(window_ms=0.5, max_batch=8)
+    boom = RuntimeError("executor is shut down")
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        real = loop.run_in_executor
+        fail = {"armed": True}
+
+        def broken(executor, fn, *args):
+            if fail["armed"]:
+                raise boom
+            return real(executor, fn, *args)
+
+        loop.run_in_executor = broken
+        try:
+            with pytest.raises(RuntimeError, match="shut down"):
+                await coal.top_n(model, np.array([1.0, 0.0]), 3)
+        finally:
+            loop.run_in_executor = real
+        assert coal._inflight == 0  # slot released, not leaked
+        # the coalescer still works once dispatch recovers
+        fail["armed"] = False
+        res = await coal.top_n(model, np.array([2.0, 0.0]), 3)
+        assert res[0][0] == "i2"
+
+    asyncio.run(main())

@@ -386,11 +386,23 @@ def test_prewarmed_generation_swap_no_compile_after_flip(tmp_path):
             layer.manager.get_model().get_yty_solver()
             client.get("/recommend/u0?considerKnownItems=true")
             c0 = compilecache.compiles_total()
+            # the burst hits the DEFAULT endpoint form — known-item
+            # exclusion carried on every request (the program the warmer
+            # now precompiles via the shape-stable exclusion width), plus
+            # the exclusion-free form; neither may compile post-handoff
             for i in range(10):
+                r = client.get(f"/recommend/u{i}")
+                assert r.status_code == 200
+                assert all(
+                    rec["id"] not in known2.get(f"u{i}", [])
+                    for rec in r.json()
+                )
+            for i in range(5):
                 r = client.get(f"/recommend/u{i}?considerKnownItems=true")
                 assert r.status_code == 200
             assert compilecache.compiles_total() - c0 == 0, (
-                "request-path compile after prewarmed generation swap"
+                "request-path compile after prewarmed generation swap "
+                "(first post-handoff /recommend burst, exclusions included)"
             )
     finally:
         layer.close()
