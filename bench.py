@@ -123,6 +123,15 @@ def _serving_bench() -> dict:
     rand.use_test_seed()
     import jax
 
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.common import profiling
+
+    # wire the roofline peaks + per-device memory gauges before any device
+    # work: the embedded metrics snapshot below must carry the MFU gauge and
+    # device-memory series even if the HTTP section (which also configures
+    # them via make_app) is skipped or fails
+    profiling.configure(cfg.get_default())
+
     from oryx_tpu.models.als.serving import ALSServingModel
 
     rng = np.random.default_rng(42)
@@ -234,24 +243,23 @@ def _serving_bench() -> dict:
         n_lsh += len(batch)
     lsh_qps = n_lsh / (time.perf_counter() - t2)
 
-    import resource
-
     from oryx_tpu.common import metrics as metrics_mod
 
     return {
         "metric": "als_recommend_throughput_1M_items_50f",
         # the round's own telemetry: registry snapshot covering the whole
         # serving section (topn/coalescer/HTTP/topic counters + histogram
-        # count/sum pairs) so perf records carry their runtime story
+        # count/sum pairs + the device-perf/MFU/memory gauges) so perf
+        # records carry their runtime story
         "metrics": metrics_mod.default_registry().snapshot(),
         "value": round(qps, 1),
         "unit": "recs/s",
         "vs_baseline": round(qps / BASELINE_QPS, 2),
-        # host RSS parity point — reference serving heap is 1400 MB at
-        # 50f × 2M rows (BASELINE.md §heap); Y also lives on-device here.
-        # ru_maxrss is KB on Linux (this deployment); bytes on macOS
-        "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        // (1024 if sys.platform != "darwin" else 1024 * 1024),
+        # host + device memory parity point — reference serving heap is
+        # 1400 MB at 50f × 2M rows (BASELINE.md §heap); Y also lives
+        # on-device here. Stable keys: trace_summary --history reads
+        # memory.host_peak_rss_mb round over round.
+        "memory": profiling.memory_snapshot(),
         # which backend produced the number — a CPU-fallback figure
         # must never be mistaken for the TPU result
         "backend": jax.default_backend(),
@@ -269,6 +277,73 @@ def _serving_bench() -> dict:
         "slowest_traces": slowest_traces,
         "http": http_section,
     }
+
+
+def _span_breakdown() -> dict:
+    """Queue/device/tunnel latency breakdown from the span ring — the
+    always-on attribution ROADMAP item 1 wants persisted next to the
+    on-chip number. Three stages per request: the HTTP ingress span (total
+    request wall), ``coalescer.queue_wait`` (time parked before dispatch),
+    and ``coalescer.device_call`` (dispatch through device completion —
+    every rider of a flush waits the whole batched call, so the per-flush
+    duration IS the per-request device share; on a tunneled backend the
+    ~80 ms RTT lives here). ``tunnel_other_mean_ms`` is the remainder:
+    ingress − queue − device ≈ aiohttp + coalescer bookkeeping + transport.
+
+    The ring keeps the most recent ``oryx.tracing.spans.ring-size`` spans,
+    so after the HTTP windows this reads as the warm-traffic tail."""
+    from oryx_tpu.common import spans as spans_mod
+
+    ring = spans_mod.default_recorder().spans()
+
+    def stats(durs: list) -> "dict | None":
+        if not durs:
+            return None
+        durs = sorted(durs)
+        n = len(durs)
+        return {
+            "count": n,
+            "mean_ms": round(1000.0 * sum(durs) / n, 2),
+            "p50_ms": round(1000.0 * durs[n // 2], 2),
+            "p99_ms": round(1000.0 * durs[min(n - 1, int(n * 0.99))], 2),
+        }
+
+    http = [s.duration for s in ring
+            if s.name.startswith("http ") and "/recommend" in s.name]
+    queue = [s.duration for s in ring if s.name == "coalescer.queue_wait"]
+    device = [s.duration for s in ring if s.name == "coalescer.device_call"]
+    out = {
+        "http": stats(http),
+        "queue_wait": stats(queue),
+        "device_call": stats(device),
+        "note": "per-request spans for http/queue_wait; device_call is "
+                "per coalesced flush (each rider waits the whole call)",
+    }
+    if out["http"] and out["queue_wait"] and out["device_call"]:
+        out["tunnel_other_mean_ms"] = round(
+            out["http"]["mean_ms"] - out["queue_wait"]["mean_ms"]
+            - out["device_call"]["mean_ms"], 2,
+        )
+    return out
+
+
+def _print_breakdown_table(breakdown: dict) -> None:
+    """Human-readable stage table on stderr (stdout carries exactly one
+    JSON line), printed next to the cold/warm splits."""
+    print("latency breakdown (span data, warm tail):", file=sys.stderr)
+    print(f"  {'stage':<12s} {'count':>7s} {'mean_ms':>9s} {'p50_ms':>9s} "
+          f"{'p99_ms':>9s}", file=sys.stderr)
+    for stage in ("http", "queue_wait", "device_call"):
+        s = breakdown.get(stage)
+        if not s:
+            print(f"  {stage:<12s} {'-':>7s}", file=sys.stderr)
+            continue
+        print(f"  {stage:<12s} {s['count']:>7d} {s['mean_ms']:>9.2f} "
+              f"{s['p50_ms']:>9.2f} {s['p99_ms']:>9.2f}", file=sys.stderr)
+    rem = breakdown.get("tunnel_other_mean_ms")
+    if rem is not None:
+        print(f"  {'tunnel/other':<12s} {'':>7s} {rem:>9.2f}  "
+              "(ingress - queue - device)", file=sys.stderr)
 
 
 def _http_bench(model, queries, duration_s: float = 5.0,
@@ -406,6 +481,10 @@ def _http_bench(model, queries, duration_s: float = 5.0,
         thread.join(timeout=10)
     cold = window_stats(cold_parts)
     warm = window_stats(warm_parts)
+    # the queue/device/tunnel attribution for the traffic just measured,
+    # read from the span ring before anything else can wrap it
+    breakdown = _span_breakdown()
+    _print_breakdown_table(breakdown)
 
     from oryx_tpu.common import metrics as metrics_mod
 
@@ -455,6 +534,7 @@ def _http_bench(model, queries, duration_s: float = 5.0,
         "p99_ms": warm["p99_ms"],
         "cold": cold,
         "warm": warm,
+        "breakdown": breakdown,
         "warmup": warmup,
         "compiles_in_warm_window": int(warm_compiles),
         "warm_window_zero_compiles": warm_compiles == 0,

@@ -32,7 +32,6 @@ result into the round benchmark record).
 
 import json
 import os
-import resource
 import sys
 import time
 
@@ -258,9 +257,12 @@ def run_batch_bench(
     if remaining() > 10.0 and time.perf_counter() + e2e_cost < hard_stop:
         record["train_e2e"] = run_train_e2e(batch, rows, cols, vals, k,
                                             device_sync)
-    record["peak_rss_mb"] = (
-        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
-    )
+    # host peak RSS + per-device HBM peaks, STABLE keys (trace_summary
+    # --history reads memory.host_peak_rss_mb round over round) — the point
+    # of the blocked solver is that this stays bounded at reference scale
+    from oryx_tpu.common import profiling
+
+    record["memory"] = profiling.memory_snapshot()
     # the other two batch-tier phases of the north-star loop (train →
     # speed-update → serve): CSV ingest and speed-layer fold-in
     return record
@@ -697,7 +699,14 @@ def main() -> None:
     else:
         fn, metric = run_batch_bench, "als_batch_train_throughput"
     try:
-        print(json.dumps(fn()))
+        record = fn()
+        # every payload flavor (--mesh/--extras/default) carries the same
+        # stable memory keys for the --history reader
+        if "memory" not in record:
+            from oryx_tpu.common import profiling
+
+            record["memory"] = profiling.memory_snapshot()
+        print(json.dumps(record))
     except Exception as e:  # noqa: BLE001 — always emit a JSON line
         print(json.dumps({"metric": metric,
                           "error": f"{type(e).__name__}: {e}"}))

@@ -165,7 +165,7 @@ def configure(config) -> None:
                     cdir, exc_info=True)
 
 
-def aot_compile(jitted, *args, **kwargs):
+def aot_compile(jitted, *args, cost_key: "str | None" = None, **kwargs):
     """Ahead-of-time ``jitted.lower(*args).compile()`` — THE sanctioned way
     to compile off the request path (analyze: compile-on-hot-path).
 
@@ -174,15 +174,25 @@ def aot_compile(jitted, *args, **kwargs):
     enabled, the persistent compilation cache, so the first on-path dispatch
     of the same signature pays a cache read instead of an XLA compile.
     Returns the compiled executable, or None when lowering/compiling fails
-    (the caller's execution-warm fallback still covers the signature)."""
+    (the caller's execution-warm fallback still covers the signature).
+
+    ``cost_key`` additionally registers the executable's ``cost_analysis()``
+    FLOPs/bytes under that program signature in the process cost registry
+    (common/profiling.py) — execution sites then attribute device work by
+    recording calls against the same key."""
     lower = getattr(jitted, "lower", None)
     if lower is None:
         return None
     try:
-        return lower(*args, **kwargs).compile()
+        compiled = lower(*args, **kwargs).compile()
     except Exception:  # noqa: BLE001 — warm path must never take a layer down
         log.debug("AOT compile failed", exc_info=True)
         return None
+    if cost_key:
+        from oryx_tpu.common import profiling
+
+        profiling.costs().register_compiled(cost_key, compiled)
+    return compiled
 
 
 class WarmupState:

@@ -244,6 +244,33 @@ oryx = {
     spec = null
   }
 
+  # Device-performance attribution (common/profiling.py): per-program XLA
+  # cost accounting feeding oryx_device_flops_total and the scrape-time
+  # MFU / HBM-bandwidth gauges, device + host memory telemetry, and the
+  # on-demand profiler behind POST /debug/profile
+  # (docs/observability.md "Device performance attribution").
+  profiling = {
+    # Per-chip matmul peak the MFU gauge divides by (TFLOP/s). 0 = auto-
+    # detect from the local device kind where known (TPU v5e); unknown
+    # kinds leave the gauge at 0 rather than reporting a made-up fraction.
+    peak-tflops = 0
+    # HBM peak for the achieved-bandwidth gauge (GB/s). 0 = auto-detect,
+    # same convention as peak-tflops.
+    peak-hbm-gbps = 0
+    # Sliding window for the scrape-time FLOP/s and bytes/s rates (an idle
+    # process decays to 0 within one window instead of freezing at its
+    # last busy rate).
+    window-sec = 60
+    # POST /debug/profile: upper bound on one capture's ?seconds= — the
+    # endpoint shares the process's single jax.profiler slot, so a capture
+    # must never be allowed to hold it indefinitely.
+    max-capture-sec = 60
+    # Base directory for on-demand captures (one timestamped subdir per
+    # capture); null = a fresh temp dir per capture. Step captures keep
+    # using oryx.tracing.profile-dir.
+    profile-dir = null
+  }
+
   # Framework-wide metrics registry + Prometheus text exposition on
   # GET /metrics (replaces the reference's Spark-UI/JMX metrics story;
   # docs/observability.md has the catalog).

@@ -22,6 +22,7 @@ import time
 from contextlib import contextmanager
 
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import profiling
 from oryx_tpu.common.lockutils import RateLimitCheck
 
 log = logging.getLogger(__name__)
@@ -57,6 +58,11 @@ class StepTracer:
         self.total_items = 0
         self.last_sec = 0.0
         self._profiling = False
+        # set when the shared ProfileSession refused a capture (another
+        # tracer or /debug/profile owns the profiler): log once, then stop
+        # attempting — jax allows exactly one capture per process, and a
+        # start_trace raise per step would spam the log for the whole run
+        self._profile_denied = False
 
     @contextmanager
     def step(self, name: str, n_items: int = 0):
@@ -103,26 +109,53 @@ class StepTracer:
                         self.tier, name, self.steps, dt, mean, n_items, rate,
                     )
 
+    @property
+    def _owner(self) -> str:
+        return f"steptracer-{self.tier}"
+
     def _start_profiler(self) -> None:
+        """Begin this tracer's step capture through the SHARED
+        :class:`profiling.ProfileSession`. Two tracers in one process
+        (batch + speed layers both enabled) used to race
+        ``jax.profiler.start_trace`` directly — the loser raised on every
+        step; now the session arbitrates and the loser quietly skips its
+        capture. Unbounded duration on purpose: batch generations can run
+        for hours, and the layer's close path stops the capture."""
         if self._profiling:
             return
+        if self._profile_denied:
+            # denied earlier (a sibling tracer or /debug/profile owned the
+            # profiler); retry only once the session frees up — a transient
+            # 5-second endpoint capture must not cost a long-running layer
+            # its configured step capture for the rest of the process
+            if profiling.profile_session().busy():
+                return
+            self._profile_denied = False
         try:
-            import jax
-
-            jax.profiler.start_trace(self.profile_dir)
+            profiling.profile_session().start(
+                self.profile_dir, owner=self._owner, max_seconds=None
+            )
             self._profiling = True
             log.info("[%s] profiler trace started -> %s", self.tier, self.profile_dir)
+        except profiling.ProfileBusyError as e:
+            self._profile_denied = True
+            log.info("[%s] profiler busy; skipping step capture (%s)",
+                     self.tier, e)
         except Exception:  # noqa: BLE001 - profiling must never kill a layer
             log.exception("failed to start profiler trace")
 
     def _stop_profiler(self) -> None:
+        """Stop OUR capture (owner-checked, so a tracer that never got the
+        session cannot cut a sibling's capture short). Reached both from
+        the step that completes the capture and from :meth:`close` — a
+        layer stopped before ``profile-steps`` steps still finalizes its
+        trace directory instead of leaving it open/truncated."""
         if not self._profiling:
             return
         try:
-            import jax
-
-            jax.profiler.stop_trace()
-            log.info("[%s] profiler trace written -> %s", self.tier, self.profile_dir)
+            if profiling.profile_session().stop(owner=self._owner) is not None:
+                log.info("[%s] profiler trace written -> %s",
+                         self.tier, self.profile_dir)
         except Exception:  # noqa: BLE001
             log.exception("failed to stop profiler trace")
         finally:

@@ -18,6 +18,7 @@ from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import faults
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
@@ -61,6 +62,10 @@ class AbstractLayer:
         compilecache.configure(config)
         resilience.configure(config)
         faults.configure(config)
+        # trainer cost accounting + memory gauges report through the same
+        # /metrics surface as serving replicas (scraped or snapshotted by
+        # bench_batch) — peaks and gauges configure here too
+        profiling.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")

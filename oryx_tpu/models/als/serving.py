@@ -34,6 +34,7 @@ from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
 from oryx_tpu.api.serving import AbstractServingModelManager
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import profiling
 from oryx_tpu.common import spans
 from oryx_tpu.models.als import pmml_codec
 from oryx_tpu.models.als.lsh import LocalitySensitiveHash
@@ -92,6 +93,15 @@ def _round_up_pow2(n: int) -> int:
 #: and pay one compile per bucket per process (persistent-cache-served
 #: afterwards), exactly like unusual howMany values.
 _EXCL_PAD_MIN = 8
+
+
+def _topn_cost_key(batch_size: int, excl: bool) -> str:
+    """Cost-accounting program signature for one batched top-N variant.
+    Keyed by (batch size, exclusion-carrying) — the axes the coalescer's
+    pow2 padding and the warm ladder actually produce; top-k width drift
+    (unusual howMany) folds into the same key, a documented approximation
+    (docs/observability.md "Device performance attribution")."""
+    return f"als.top_n_batch/b{batch_size}" + ("+excl" if excl else "")
 
 
 def _score(qs, mat):
@@ -243,6 +253,18 @@ class _YSnapshot:
     ):
         self.ids = ids
         self.mat = mat  # jax (n, k) or None, float32
+        # lazy cost-registration marks (see _top_n_batch): per GENERATION so
+        # a model swap re-registers against the new shapes, but carried
+        # across same-shape incremental snapshots (point-update microbatches
+        # whose dispatch signatures — and therefore per-call costs — are
+        # unchanged). Marked even when registration fails, so a backend
+        # without usable cost_analysis never re-pays lower+compile per call.
+        if (prev is not None
+                and getattr(prev.mat, "shape", None)
+                == getattr(mat, "shape", None)):
+            self.cost_keys_attempted = prev.cost_keys_attempted
+        else:
+            self.cost_keys_attempted: set = set()
         if prev is not None and delta is not None:
             # id→idx is append-only across incremental generations; sharing
             # the dict avoids an O(n) rebuild per microbatch (extra entries
@@ -602,6 +624,12 @@ class ALSServingModel(ServingModel):
         qs_host = np.asarray(query_vecs, dtype=np.float32)
         filtering = alloweds is not None and any(a is not None for a in alloweds)
         if snap.sharded_mat is not None and not filtering:
+            # sharded scan: calls are attributed (cost accounting counts
+            # them) but no per-call cost is registered for the multi-shard
+            # program — the calls-without-flops gap stays visible
+            profiling.costs().record(
+                f"als.top_n_batch/b{len(qs_host)}+sharded"
+            )
             vals, idx = self._sharded_query(snap, qs_host, how_many, excluded)
             vals, idx = vals[:, :how_many], idx[:, :how_many]
             ids = snap.ids
@@ -617,20 +645,43 @@ class ALSServingModel(ServingModel):
             if use_excl
             else None
         )
+        cost_reg = profiling.costs()
+        cost_key = _topn_cost_key(len(qs_host), use_excl)
         if self.lsh is None or snap.buckets is None:
             k = min(
                 snap.n,
                 _round_up_pow2(max(2 * how_many, 64) if filtering else max(how_many, 16)),
             )
+            if (cost_key not in snap.cost_keys_attempted
+                    and metrics_mod.default_registry().enabled):
+                # first use of this signature this generation: the dispatch
+                # below pays the XLA compile anyway — the sanctioned AOT
+                # route shares that compile AND yields the executable's
+                # cost_analysis, so unwarmed signatures (odd batch sizes,
+                # direct callers) still attribute FLOPs instead of reading
+                # zero forever
+                snap.cost_keys_attempted.add(cost_key)
+                compilecache.aot_compile(
+                    _top_k_dot_batch, snap.score_mat, qs, None, excl, k,
+                    cost_key=cost_key,
+                )
             vals, idx = _top_k_dot_batch(snap.score_mat, qs, None, excl, k)
         else:
             # per-query LSH candidate masks: (B, num_buckets) lookup table
             # indexed by item bucket on device
             k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
+            lut = jnp.asarray(self._build_lut(qs_host))
+            if (cost_key not in snap.cost_keys_attempted
+                    and metrics_mod.default_registry().enabled):
+                snap.cost_keys_attempted.add(cost_key)
+                compilecache.aot_compile(
+                    _top_k_dot_batch_masked, snap.score_mat, qs, lut,
+                    snap.buckets, excl, k, cost_key=cost_key,
+                )
             vals, idx = _top_k_dot_batch_masked(
-                snap.score_mat, qs, jnp.asarray(self._build_lut(qs_host)),
-                snap.buckets, excl, k
+                snap.score_mat, qs, lut, snap.buckets, excl, k
             )
+        cost_reg.record(cost_key)
         vals, idx = np.asarray(vals), np.asarray(idx)
         if not filtering:
             ids = snap.ids
@@ -691,11 +742,13 @@ class ALSServingModel(ServingModel):
         elif self.lsh is None or snap.buckets is None:
             k = min(snap.n, _round_up_pow2(max(how_many, 16)))
             compilecache.aot_compile(
-                _top_k_dot_batch, snap.score_mat, qs_struct, None, None, k
+                _top_k_dot_batch, snap.score_mat, qs_struct, None, None, k,
+                cost_key=_topn_cost_key(batch_size, False),
             )
             compilecache.aot_compile(
                 _top_k_dot_batch, snap.score_mat, qs_struct, None,
-                excl_struct, k
+                excl_struct, k,
+                cost_key=_topn_cost_key(batch_size, True),
             )
         else:
             k = min(snap.n, _round_up_pow2(max(2 * how_many, 64)))
@@ -704,12 +757,23 @@ class ALSServingModel(ServingModel):
             )
             compilecache.aot_compile(
                 _top_k_dot_batch_masked, snap.score_mat, qs_struct,
-                lut_struct, snap.buckets, None, k
+                lut_struct, snap.buckets, None, k,
+                cost_key=_topn_cost_key(batch_size, False),
             )
             compilecache.aot_compile(
                 _top_k_dot_batch_masked, snap.score_mat, qs_struct,
-                lut_struct, snap.buckets, excl_struct, k
+                lut_struct, snap.buckets, excl_struct, k,
+                cost_key=_topn_cost_key(batch_size, True),
             )
+        if snap.sharded_mat is None:
+            # mark both signatures attempted: the lazy first-use
+            # registration in _top_n_batch would otherwise re-lower and
+            # re-compile each one the ladder just registered — once per
+            # signature per generation, during the handoff warm window
+            snap.cost_keys_attempted.update({
+                _topn_cost_key(batch_size, False),
+                _topn_cost_key(batch_size, True),
+            })
         zeros = np.zeros((batch_size, self.features), dtype=np.float32)
         self.top_n_batch(zeros, how_many)
         # one real exclusion-carrying execution: an id no snapshot contains

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oryx_tpu.common import profiling
 from oryx_tpu.models.als.data import RatingBatch
 
 # Budgets (in f32 elements) bounding the two big transients: the per-block
@@ -889,6 +890,38 @@ def init_item_factors(item_side: _BlockedSide, n_items: int, features: int,
     return _init_factors(item_side.padded_rows, n_items, features, key)
 
 
+def _register_half_cost(key: str, side: _BlockedSide, nnz: int,
+                        features: int, dtype: str) -> None:
+    """Analytic per-half-iteration device cost for the trainer's cost
+    accounting (common/profiling.py): the same useful-FLOP model the batch
+    bench's MFU derives from (2·nnz·k² Gramian + 2·nnz·k RHS +
+    rows·(k³/3 + 2k²) solve), with bytes as the dominant HBM terms — the
+    slot-cell gather at the compute dtype plus the per-row Gramian and
+    factor writes. The blocked solver is a scan of sub-programs rather than
+    one compiled executable, so the trainer registers analytically where
+    serving registers from ``cost_analysis()``; either way the label is one
+    program signature multiplied by recorded calls."""
+    k = features
+    rows = side.padded_rows
+    flops = (2.0 * nnz * k * k + 2.0 * nnz * k
+             + rows * (k ** 3 / 3.0 + 2.0 * k * k))
+    gather_itemsize = 2.0 if dtype == "bfloat16" else 4.0
+    bytes_ = (float(side.scols.size) * k * gather_itemsize
+              + rows * k * (k + 1) * 4.0)
+    profiling.costs().register(key, flops, bytes_)
+
+
+def _recorded_half(key: str, fn):
+    """Wrap a half-iteration solver so each dispatch lands in the device
+    cost counters (oryx_device_flops_total{program=key} et al.)."""
+
+    def call(*args):
+        profiling.costs().record(key)
+        return fn(*args)
+
+    return call
+
+
 def als_train(
     batch: RatingBatch,
     features: int,
@@ -985,6 +1018,7 @@ def als_train(
         side = item_fut.result()
         wait_s = time.perf_counter() - t1
         pool.shutdown(wait=False)
+        _register_half_cost("als.train.item_half", side, batch.nnz, k, dtype)
         if layout_cache is not None:
             layout_cache.store_batch(batch.rows, batch.cols, batch.vals)
         if timings is not None:
@@ -1007,6 +1041,8 @@ def als_train(
         user_side = pack_user()
         pack_user_s = time.perf_counter() - t0
         chunk_u = user_side.slot_chunk
+        _register_half_cost("als.train.user_half", user_side, batch.nnz, k,
+                            dtype)
 
         if key is None:
             key = rand.get_key()
@@ -1032,15 +1068,15 @@ def als_train(
             y = jax.device_put(y, row_shard)
             on_tpu = _use_spd_kernel(mesh=mesh)
             fused = _resolve_fused(fused_gramian, on_tpu, k)
-            solve_u = _sharded_solver(mesh, row_axis, block_u, k, implicit,
-                                      chunk_u, dtype, on_tpu, fused,
-                                      not on_tpu)
+            solve_u = _recorded_half("als.train.user_half", _sharded_solver(
+                mesh, row_axis, block_u, k, implicit, chunk_u, dtype, on_tpu,
+                fused, not on_tpu))
             x = solve_u(y, *u_arrays, lam, alpha)  # device busy; host packs
             item_side, _ = finish_item_pack()
             i_arrays = put_side(item_side)
-            solve_i = _sharded_solver(mesh, row_axis, block_i, k, implicit,
-                                      item_side.slot_chunk, dtype, on_tpu,
-                                      fused, not on_tpu)
+            solve_i = _recorded_half("als.train.item_half", _sharded_solver(
+                mesh, row_axis, block_i, k, implicit, item_side.slot_chunk,
+                dtype, on_tpu, fused, not on_tpu))
             y = solve_i(x, *i_arrays, lam, alpha)
             for _ in range(iterations - 1):
                 x = solve_u(y, *u_arrays, lam, alpha)
@@ -1048,6 +1084,10 @@ def als_train(
             return x, y
 
         def solve(side, opp, blk, ck):
+            profiling.costs().record(
+                "als.train.user_half" if side is user_side
+                else "als.train.item_half"
+            )
             return solve_side_blocked(
                 opp, side.srows, side.scols, side.svals, side.slens, lam,
                 alpha, block=blk, features=k, implicit=implicit,

@@ -31,6 +31,7 @@ from oryx_tpu.common import compilecache
 from oryx_tpu.common import faults
 from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
@@ -294,6 +295,10 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     compilecache.configure(config)
     resilience.configure(config)
     faults.configure(config)
+    # roofline peaks + device-memory gauges + the profiler session config
+    # (after the others: jax is imported by now, so peak auto-detection and
+    # per-device gauge wiring can see the live backend)
+    profiling.configure(config)
     middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
     dl_mw = _deadline_middleware(config)
     if dl_mw is not None:
@@ -362,11 +367,12 @@ def _exempt_canonicals(config) -> frozenset:
     crafted path can never spoof the exemption.
 
     ``/healthz``/``/readyz`` are ALWAYS exempt (load balancers cannot speak
-    digest, and the probes leak nothing beyond up/down); ``/metrics`` and
-    ``/trace`` are exempt unless ``oryx.metrics.require-auth``."""
+    digest, and the probes leak nothing beyond up/down); ``/metrics``,
+    ``/trace``, and ``/debug/profile`` share one auth story — exempt unless
+    ``oryx.metrics.require-auth``."""
     templates = {"/healthz", "/readyz"}
     if not config.get_bool("oryx.metrics.require-auth", False):
-        templates |= {"/metrics", "/trace"}
+        templates |= {"/metrics", "/trace", "/debug/profile"}
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
     prefix = context_path.rstrip("/")
     return frozenset(templates | {prefix + t for t in templates})

@@ -1,4 +1,5 @@
-"""Framework-level endpoints: /ready, /error, /metrics, /trace, and probes.
+"""Framework-level endpoints: /ready, /error, /metrics, /trace, probes,
+and the on-demand profiler.
 
 Equivalent of the reference's Ready (app/oryx-app-serving/.../Ready.java:33)
 and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35);
@@ -8,16 +9,22 @@ visibility (SURVEY §5.1). /trace renders the span ring buffer
 (common/spans.py): recent spans, the kept-slowest per route, or one whole
 trace by id. /healthz (liveness) and /readyz (readiness: model loaded +
 update-consumer lag under ``oryx.serving.ready-max-lag-sec``) are the
-load-balancer probe pair — always auth-exempt.
+load-balancer probe pair — always auth-exempt. POST /debug/profile captures
+a timed ``jax.profiler`` trace of the LIVE process through the shared
+one-at-a-time session (common/profiling.py) — 409 while another capture is
+in flight, auth story identical to /metrics.
 """
 
 from __future__ import annotations
+
+import asyncio
 
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import profiling
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 
@@ -148,6 +155,52 @@ async def trace(request: web.Request) -> web.Response:
     })
 
 
+async def debug_profile(request: web.Request) -> web.Response:
+    """On-demand device profiling of the live process:
+    ``POST /debug/profile?seconds=N`` captures a ``jax.profiler`` trace for
+    N seconds (clamped to ``oryx.profiling.max-capture-sec``) and answers
+    with the trace directory — readable by TensorBoard/XProf or
+    ``python -m oryx_tpu.tools.trace_summary <dir>``. Exactly ONE capture
+    may be in flight per process (jax's own constraint): a concurrent
+    request answers 409 naming the current owner. The capture runs in a
+    worker thread (``asyncio.to_thread``) so the event loop keeps serving
+    — profiling a replica must not stall its traffic. Auth story = /metrics
+    (exempt unless ``oryx.metrics.require-auth``)."""
+    config = request.app[rsrc.CONFIG_KEY]
+    try:
+        seconds = float(request.query.get("seconds", "3"))
+    except ValueError as e:
+        raise OryxServingException(400, "bad seconds") from e
+    max_seconds = config.get_float("oryx.profiling.max-capture-sec", 60.0)
+    rsrc.check(seconds > 0, "seconds must be positive")
+    rsrc.check(seconds <= max_seconds,
+               f"seconds capped at {max_seconds:g} "
+               "(oryx.profiling.max-capture-sec)")
+    session = profiling.profile_session()
+    if session.busy():
+        # fast-path refusal; the start() inside capture() still guards the
+        # race where two requests pass this check together
+        raise OryxServingException(
+            409, f"profiler capture already in flight "
+                 f"(owner={session.owner()!r})"
+        )
+    try:
+        # dir creation + capture are ONE worker-thread hop: both block, and
+        # neither may stall the loop of the replica being profiled
+        trace_dir = await asyncio.to_thread(
+            profiling.timed_capture,
+            config.get_string("oryx.profiling.profile-dir", None),
+            seconds, "debug-endpoint",
+        )
+    except profiling.ProfileBusyError as e:
+        raise OryxServingException(409, str(e)) from e
+    return web.json_response({
+        "trace_dir": trace_dir,
+        "seconds": seconds,
+        "hint": f"python -m oryx_tpu.tools.trace_summary {trace_dir}",
+    })
+
+
 def register(app: web.Application) -> None:
     app.router.add_route("GET", "/ready", ready)
     app.router.add_route("HEAD", "/ready", ready)
@@ -158,3 +211,4 @@ def register(app: web.Application) -> None:
     app.router.add_route("GET", "/error", error)
     app.router.add_route("GET", "/metrics", metrics)
     app.router.add_route("GET", "/trace", trace)
+    app.router.add_route("POST", "/debug/profile", debug_profile)

@@ -1,14 +1,23 @@
-"""Summarize a jax.profiler trace directory OR a /metrics registry dump.
+"""Summarize a jax.profiler trace directory, a /metrics registry dump, or
+the round-over-round ``BENCH_*.json`` perf history.
 
-One tool reads both runtime-visibility sources:
+One tool reads the runtime-visibility sources:
 
-  * **profiler traces** — the profiler (``oryx.tracing.profile-dir`` or the
-    benches' ``ORYX_PROFILE_DIR``) writes a Chrome-trace
-    ``*.trace.json.gz``; this prints top device ops by SELF time.
+  * **profiler traces** — the profiler (``oryx.tracing.profile-dir``, the
+    benches' ``ORYX_PROFILE_DIR``, or a ``POST /debug/profile`` capture)
+    writes a Chrome-trace ``*.trace.json.gz``; this prints top device ops
+    by SELF time.
   * **live registries** — a Prometheus text dump from ``GET /metrics``
     (docs/observability.md), given as a file or fetched straight from a
     URL; this prints the per-step/per-histogram duration table (count,
-    total, mean, bucket-estimated p50/p95/p99) plus the top counters.
+    total, mean, bucket-estimated p50/p95/p99), the device-performance
+    series (attributed FLOP/s, MFU, HBM bandwidth, device/host memory from
+    common/profiling.py), and the top counters.
+  * **perf history** — ``--history BENCH_r0*.json`` renders the round-over-
+    round trajectory (serving qps, HTTP qps/p99, trainer MFU, pack vs
+    device wall, peak RSS) and exits NONZERO when the newest round regressed
+    more than ``--regress-pct`` (default 25%) against the previous round on
+    any tracked series — the BENCH files' first automated consumer.
 
 Reference counterpart: Oryx's Spark UI timing breakdowns (batch UI port,
 reference.conf:153) — here the equivalent visibility for jit'd device
@@ -21,6 +30,8 @@ Usage:
     python -m oryx_tpu.tools.trace_summary <server-url-or-trace-json> \
         --trace-id <32-hex id>
     python -m oryx_tpu.tools.trace_summary <bench-batch-json> --batch
+    python -m oryx_tpu.tools.trace_summary --history BENCH_r0*.json \
+        [--regress-pct 25]
 
 ``--batch`` renders a ``bench_batch.py`` record: throughput/MFU per input
 precision, the fused-vs-unfused Gramian split, the gather/einsum/scatter/
@@ -261,8 +272,10 @@ def bucket_quantile(bucket_rows: list, count: float, q: float) -> float:
 
 
 def summarize_metrics(text: str, top: int = 15) -> tuple:
-    """Returns (histogram rows, counter rows) ready for printing:
-    histogram rows are (series, count, sum, mean, p50, p95, p99)."""
+    """Returns (histogram rows, counter rows, scalars) ready for printing:
+    histogram rows are (series, count, sum, mean, p50, p95, p99); scalars
+    are the raw (name, labels, value) triples so callers (the device-perf
+    section) don't re-parse the dump."""
     histograms, scalars = parse_metrics_text(text)
     hist_rows = []
     for base in sorted(histograms):
@@ -284,11 +297,47 @@ def summarize_metrics(text: str, top: int = 15) -> tuple:
         ),
         key=lambda t: -t[1],
     )[:top]
-    return hist_rows, counter_rows
+    return hist_rows, counter_rows, scalars
+
+
+#: Scalar-name prefixes of the device-performance attribution series
+#: (common/profiling.py) pulled into their own section of the metrics view.
+_DEVICE_PERF_PREFIXES = ("oryx_device_", "oryx_host_")
+
+#: Renderings for the headline device-perf gauges (value -> display).
+_DEVICE_PERF_FMT = {
+    "oryx_device_mfu": lambda v: f"{100.0 * v:.3f}% MFU",
+    "oryx_device_hbm_bandwidth_fraction":
+        lambda v: f"{100.0 * v:.2f}% of HBM peak",
+    "oryx_device_flops_per_second": lambda v: f"{v / 1e12:.4f} TFLOP/s",
+    "oryx_device_bytes_per_second": lambda v: f"{v / 1e9:.3f} GB/s",
+}
+
+
+def device_perf_rows(scalars: list) -> list:
+    """(series, value, pretty) rows for the device-performance section of a
+    metrics dump: cost-accounting counters/rates, MFU/bandwidth fractions,
+    and device/host memory gauges."""
+    rows = []
+    for name, key, value in scalars:
+        if not name.startswith(_DEVICE_PERF_PREFIXES):
+            continue
+        label = ",".join(f"{k}={v}" for k, v in key)
+        series = f"{name}{{{label}}}" if label else name
+        fmt = _DEVICE_PERF_FMT.get(name)
+        if fmt is not None:
+            pretty = fmt(value)
+        elif name.endswith("_bytes") or "memory" in name:
+            pretty = f"{value / (1024.0 ** 2):.1f} MiB"
+        else:
+            pretty = f"{value:,.0f}"
+        rows.append((series, value, pretty))
+    rows.sort(key=lambda r: r[0])
+    return rows
 
 
 def _print_metrics_summary(text: str, top: int) -> int:
-    hist_rows, counter_rows = summarize_metrics(text, top)
+    hist_rows, counter_rows, scalars = summarize_metrics(text, top)
     print("histograms (per-step durations / distributions from buckets):")
     if not hist_rows:
         print("  (none)")
@@ -298,6 +347,11 @@ def _print_metrics_summary(text: str, top: int) -> int:
     for series, n, total, mean, p50, p95, p99 in hist_rows:
         print(f"  {series[:58]:58s} {n:9.0f} {total:11.4f} {mean:9.4f} "
               f"{p50:9.4f} {p95:9.4f} {p99:9.4f}")
+    perf_rows = device_perf_rows(scalars)
+    if perf_rows:
+        print("\ndevice performance (cost accounting + memory telemetry):")
+        for series, _value, pretty in perf_rows:
+            print(f"  {pretty:>22s}  {series[:72]}")
     print(f"\ntop {top} counters/gauges:")
     for series, value in counter_rows:
         print(f"  {value:14.1f}  {series[:76]}")
@@ -465,17 +519,185 @@ def render_batch_record(payload: dict, out=None) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --history mode: the BENCH_*.json round-over-round trajectory
+# ---------------------------------------------------------------------------
+
+#: Tracked series: (_history_row column, higher_is_better). A regression on
+#: ANY of them past --regress-pct flips the exit code — the contract that
+#: makes the BENCH files a gate instead of an archive.
+_HISTORY_SERIES = (
+    ("qps", True),
+    ("http_qps", True),
+    ("p99_ms", False),
+    ("mfu", True),
+)
+
+
+def _num(v) -> "float | None":
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _hist_p99(rec: dict) -> "float | None":
+    http = rec.get("http") or {}
+    if isinstance(http.get("p99_ms"), (int, float)):
+        return float(http["p99_ms"])
+    lat = rec.get("latency_ms") or {}
+    return _num(lat.get("p99"))
+
+
+def load_history_records(paths: list) -> list:
+    """[(label, record)] in the given order. Accepts the driver's BENCH
+    wrapper ({"n": round, "parsed": record}) or a bare bench record; files
+    whose record is missing/unparseable are skipped with a note on stderr
+    (a crashed round must not hide the rounds around it)."""
+    out = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"history: skipping {path}: {e}", file=sys.stderr)
+            continue
+        rec = doc.get("parsed", doc) if isinstance(doc, dict) else None
+        if not isinstance(rec, dict) or not rec:
+            print(f"history: skipping {path}: no parsed bench record",
+                  file=sys.stderr)
+            continue
+        label = doc.get("n")
+        if label is None:
+            digits = re.findall(r"\d+", os.path.basename(path))
+            label = int(digits[-1]) if digits else os.path.basename(path)
+        out.append((f"r{label}" if isinstance(label, int) else str(label),
+                    rec))
+    return out
+
+
+def _history_row(label: str, rec: dict) -> dict:
+    batch = rec.get("batch") or {}
+    if not batch and ("pack_s" in rec or "mfu" in rec):
+        # a bare bench_batch payload (not bench.py's composite): the batch
+        # series live at top level
+        batch = rec
+    memory = rec.get("memory") or batch.get("memory") or {}
+    peak_mb = memory.get("host_peak_rss_mb")
+    if peak_mb is None:
+        # pre-PR-7 records carried an ad-hoc peak_rss_mb at one of two spots
+        peak_mb = rec.get("peak_rss_mb", batch.get("peak_rss_mb"))
+    return {
+        "round": label,
+        "backend": rec.get("backend", "?"),
+        "qps": _num(rec.get("value")),
+        "http_qps": _num((rec.get("http") or {}).get("value")),
+        "p99_ms": _hist_p99(rec),
+        "mfu": _num(batch.get("mfu")),
+        "pack_s": _num(batch.get("pack_s")),
+        "elapsed_s": _num(batch.get("elapsed_s")),
+        "peak_rss_mb": _num(peak_mb),
+    }
+
+
+def render_history(records: list, regress_pct: float = 25.0,
+                   out=None) -> int:
+    """Print the trajectory table; returns 1 when the NEWEST round
+    regressed more than ``regress_pct`` percent against the previous round
+    carrying the same series (missing/None cells never compare)."""
+    out = out if out is not None else sys.stdout
+    w = out.write
+    if not records:
+        w("history: no usable BENCH records\n")
+        return 2
+    rows = [_history_row(label, rec) for label, rec in records]
+
+    def cell(v, fmt, width):
+        return fmt.format(v) if v is not None else "-".rjust(width)
+
+    w(f"{'round':>6s} {'backend':>8s} {'qps':>10s} {'http_qps':>9s} "
+      f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
+      f"{'peak_rss':>9s}\n")
+    for r in rows:
+        # pack-vs-device-wall verdict rides next to elapsed: "<" = the
+        # host pack fits under the device loop (ROADMAP item 2's target)
+        overlap = "   "
+        if r["pack_s"] is not None and r["elapsed_s"] is not None:
+            overlap = " < " if r["pack_s"] < r["elapsed_s"] else " >="
+        w(f"{r['round']:>6s} {r['backend']:>8s} "
+          f"{cell(r['qps'], '{:10.1f}', 10)} "
+          f"{cell(r['http_qps'], '{:9.1f}', 9)} "
+          f"{cell(r['p99_ms'], '{:9.1f}', 9)} {cell(r['mfu'], '{:8.4f}', 8)} "
+          f"{cell(r['pack_s'], '{:8.2f}', 8)} "
+          f"{cell(r['elapsed_s'], '{:9.2f}', 9)}{overlap}"
+          f"{cell(r['peak_rss_mb'], '{:7.0f}MB', 9)}\n")
+    if regress_pct <= 0 or len(rows) < 2:
+        return 0
+    last = rows[-1]
+    regressions = []
+    for column, higher_better in _HISTORY_SERIES:
+        cur = last[column]
+        if cur is None:
+            continue
+        # compare only against a round measured on the SAME backend: a CPU
+        # fallback round "regressing" against an on-chip round is a tunnel
+        # story, not a code regression (unknown backends match anything)
+        prev_row = next(
+            (r for r in reversed(rows[:-1])
+             if r[column] is not None
+             and ("?" in (r["backend"], last["backend"])
+                  or r["backend"] == last["backend"])), None
+        )
+        if prev_row is None or prev_row[column] == 0:
+            continue
+        prev = prev_row[column]
+        delta_pct = 100.0 * (cur - prev) / abs(prev)
+        bad = (delta_pct < -regress_pct if higher_better
+               else delta_pct > regress_pct)
+        if bad:
+            regressions.append(
+                f"REGRESSION: {column} {prev:g} ({prev_row['round']}) -> "
+                f"{cur:g} ({last['round']}), {delta_pct:+.1f}% "
+                f"(threshold {regress_pct:g}%)"
+            )
+    for line in regressions:
+        w(line + "\n")
+    if regressions:
+        return 1
+    w(f"no regression beyond {regress_pct:g}% in {last['round']} "
+      f"vs prior rounds\n")
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     top = 15
     track_filter = None
     force_metrics = False
     force_batch = False
+    history = False
+    regress_pct = 25.0
     trace_id = None
     try:
         if "--batch" in args:
             force_batch = True
             args.remove("--batch")
+        if "--history" in args:
+            history = True
+            args.remove("--history")
+        if "--regress-pct" in args:
+            i = args.index("--regress-pct")
+            regress_pct = float(args[i + 1])
+            del args[i:i + 2]
+        if history:
+            # one or more BENCH files (shell-globbed or literal patterns);
+            # a stray flag must error loudly, not be "skipped" as a missing
+            # file while the real files render and the exit code stays 0
+            unknown = [a for a in args if a.startswith("-")]
+            if unknown:
+                raise ValueError(
+                    f"unknown flag(s) in --history mode: {unknown}")
+            paths = [p for a in args for p in (sorted(glob.glob(a)) or [a])]
+            if not paths:
+                raise ValueError("expected at least one BENCH_*.json")
+            return render_history(load_history_records(paths), regress_pct)
         if "--top" in args:
             i = args.index("--top")
             top = int(args[i + 1])

@@ -297,6 +297,56 @@ def test_hot_compile_quiet_on_warmup_route_and_str_lower():
     assert hits == []
 
 
+_PROFILE_CAPTURE_HELPER = """
+    import time
+
+    def timed_capture(base, seconds, owner):
+        time.sleep(seconds)   # blocking by design: the worker-thread body
+        return base
+"""
+
+
+def test_profile_endpoint_shape_passes_both_hot_path_checkers():
+    """The /debug/profile handler pattern (serving/resources/common.py):
+    directory creation + the timed jax.profiler capture are ONE
+    ``asyncio.to_thread`` hop off the event loop, and nothing on the path
+    compiles — both hot-path checkers must stay quiet on this shape. (The
+    real handler is also held to this by the zero-findings project gate.)"""
+    src = """
+        import asyncio
+
+        from helper import timed_capture
+
+        async def debug_profile(request, config):
+            trace_dir = await asyncio.to_thread(
+                timed_capture, "/tmp/captures", 3.0, "debug-endpoint")
+            return trace_dir
+    """
+    extra = {"helper.py": textwrap.dedent(_PROFILE_CAPTURE_HELPER)}
+    assert _run(src, "blocking-async", extra_sources=extra,
+                filename="oryx_tpu/serving/fixture.py") == []
+    assert _run(src, "compile-on-hot-path", extra_sources=extra,
+                filename="oryx_tpu/serving/fixture.py") == []
+
+
+def test_blocking_async_fires_when_capture_skips_the_thread_hop():
+    """Seeded violation of the same shape: calling the capture inline would
+    park the event loop for the whole ``?seconds=`` — profiler start/stop
+    must hop off the loop, and the checker enforces it transitively."""
+    hits = _run(
+        """
+        from helper import timed_capture
+
+        async def debug_profile(request, config):
+            return timed_capture("/tmp/captures", 3.0, "debug-endpoint")
+        """,
+        "blocking-async",
+        extra_sources={"helper.py": textwrap.dedent(_PROFILE_CAPTURE_HELPER)},
+        filename="oryx_tpu/serving/fixture.py",
+    )
+    assert len(hits) == 1 and "timed_capture" in hits[0].message
+
+
 # ---------------------------------------------------------------------------
 # lock-discipline
 # ---------------------------------------------------------------------------
