@@ -44,6 +44,7 @@ LAST_TPU_PATH = os.path.join(os.path.dirname(__file__), ".bench_last_tpu.json")
 BATCH_SUBPROC_TIMEOUT = 420  # ALS loops budget 210 s + gen/pack + compiles
 EXTRAS_SUBPROC_TIMEOUT = 360  # internal deadline 280 s + final section slack
 SERVING_SUBPROC_TIMEOUT = 420
+TRANSPORT_SUBPROC_TIMEOUT = 180  # 3 backends x (throughput + wakeup trials)
 
 # the launch environment's platform setting, BEFORE any fallback mutates it —
 # probes and accelerator subprocesses must see this, not a sticky "cpu"
@@ -593,6 +594,110 @@ def _http_client_proc(args) -> tuple:
     return asyncio.run(drive())
 
 
+def _transport_bench(n_msgs: int = 2_000, n_wakeup_trials: int = 12,
+                     schemes: tuple = ("memory", "file", "tcp")) -> dict:
+    """Broker microbench across all three transports (runs inside the
+    --transport subprocess; jax never loads — the data plane is pure
+    Python). Three numbers per backend:
+
+      * append_per_sec / consume_per_sec — small-message throughput through
+        broker.append and the blocking ConsumeDataIterator;
+      * wakeup p50/p99 — append-to-delivery latency into a consumer that
+        has been IDLE long enough for the file poller's backoff to grow
+        (the tail a serving replica sees between model generations). This
+        is the number the tcp broker's push-wakeup exists to crush:
+        ``memory:`` wakes on a condition variable, ``tcp:`` on a
+        server-side long-poll at network RTT, while ``file:`` sleeps out
+        its exponential poll backoff.
+    """
+    import tempfile
+    import threading
+
+    from oryx_tpu.transport import netbroker
+    from oryx_tpu.transport import topic as tp
+
+    idle_gap_sec = 0.25  # lets the file poller's backoff climb past ~100ms
+    payload = "x" * 64
+    out: dict = {"metric": "transport_microbench", "backends": {}}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        for scheme in schemes:
+            server = None
+            if scheme == "memory":
+                url = "memory:bench"
+            elif scheme == "file":
+                url = f"file:{os.path.join(tmp, 'filebroker')}"
+            else:
+                server = netbroker.NetBrokerServer(
+                    os.path.join(tmp, "tcpbroker"), host="127.0.0.1", port=0,
+                ).start_background()
+                url = f"tcp://127.0.0.1:{server.port}"
+            try:
+                broker = tp.get_broker(url)
+                broker.create_topic("Bench")
+
+                t0 = time.perf_counter()
+                for i in range(n_msgs):
+                    broker.append("Bench", f"k{i}", payload)
+                append_s = time.perf_counter() - t0
+
+                it = tp.ConsumeDataIterator(broker, "Bench", "earliest")
+                t0 = time.perf_counter()
+                for _ in range(n_msgs):
+                    next(it)
+                consume_s = time.perf_counter() - t0
+                it.close()
+
+                # wakeup RTT: a parked consumer (drained, then idle) gets
+                # one append; message body carries the send stamp
+                lats_ms: list = []
+                got = threading.Event()
+                wake_it = tp.ConsumeDataIterator(broker, "Bench", "latest")
+
+                def consume_stamps(wake_it=wake_it, lats_ms=lats_ms, got=got):
+                    for km in wake_it:
+                        lats_ms.append(
+                            1000 * (time.perf_counter() - float(km.message))
+                        )
+                        got.set()
+
+                consumer = threading.Thread(target=consume_stamps, daemon=True)
+                consumer.start()
+                # one untimed warmup: the consumer thread may not be parked
+                # yet on the very first append (its latency is thread-start
+                # jitter, not transport wakeup)
+                for trial in range(n_wakeup_trials + 1):
+                    time.sleep(idle_gap_sec)
+                    got.clear()
+                    broker.append("Bench", "w", repr(time.perf_counter()))
+                    if not got.wait(30):
+                        raise RuntimeError(f"{scheme}: wakeup never delivered")
+                    if trial == 0:
+                        lats_ms.clear()
+                wake_it.close()
+                consumer.join(timeout=10)
+
+                lat = np.asarray(sorted(lats_ms))
+                out["backends"][scheme] = {
+                    "append_per_sec": round(n_msgs / append_s, 1),
+                    "consume_per_sec": round(n_msgs / consume_s, 1),
+                    "wakeup_p50_ms": round(float(np.percentile(lat, 50)), 3),
+                    "wakeup_p99_ms": round(float(np.percentile(lat, 99)), 3),
+                    "wakeup_trials": n_wakeup_trials,
+                }
+            finally:
+                if server is not None:
+                    server.close()
+                    tp.reset_tcp_clients()
+    # the headline claim: push wakeup beats poll backoff
+    if "tcp" in out["backends"] and "file" in out["backends"]:
+        out["tcp_beats_file_wakeup"] = (
+            out["backends"]["tcp"]["wakeup_p99_ms"]
+            < out["backends"]["file"]["wakeup_p99_ms"]
+        )
+    return out
+
+
 def _section_subproc(argv: list, timeout: int, force_cpu: bool = False,
                      env: "dict | None" = None, *, metric: str) -> dict:
     """One bench section in its own subprocess with its own timeout: a hang
@@ -667,6 +772,13 @@ def main() -> None:
         300, env=mesh_env, metric="als_batch_train_mesh",
     )
 
+    # broker microbench: pure-Python data plane, always CPU, own subprocess
+    record["transport"] = _section_subproc(
+        [os.path.join(here, "bench.py"), "--transport"],
+        TRANSPORT_SUBPROC_TIMEOUT, force_cpu=True,
+        metric="transport_microbench",
+    )
+
     # the most recent on-chip evidence rides along with provenance, so a
     # tunnel flap during THIS run cannot erase the round's TPU record
     last = _load_last_tpu()
@@ -676,6 +788,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--transport" in sys.argv:
+        try:
+            print(json.dumps(_transport_bench()))
+        except Exception as e:  # noqa: BLE001 — always emit a JSON line
+            print(json.dumps({
+                "metric": "transport_microbench",
+                "error": f"{type(e).__name__}: {e}",
+            }))
+        sys.exit(0)
     if "--serving" in sys.argv:
         try:
             print(json.dumps(_serving_bench()))

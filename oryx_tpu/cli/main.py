@@ -2,14 +2,17 @@
 
 Equivalent of the reference's deploy tier (deploy/oryx-{batch,speed,serving}
 Main.java:30-37 and deploy/bin/oryx-run.sh:16-36): commands
-``batch | speed | serving | topic-setup | topic-tail | topic-input``. Each
-layer command constructs its layer from the (default-overlaid) config file,
-registers shutdown close, starts, and awaits termination; the topic commands
-mirror ``kafka-setup`` / ``kafka-tail`` / ``kafka-input``.
+``batch | speed | serving | broker | topic-setup | topic-tail |
+topic-input``. Each layer command constructs its layer from the
+(default-overlaid) config file, registers shutdown close, starts, and awaits
+termination; the topic commands mirror ``kafka-setup`` / ``kafka-tail`` /
+``kafka-input``; ``broker`` runs the ``tcp:`` network broker server (the
+Kafka-broker-process equivalent, transport/netbroker.py).
 
 Usage::
 
     python -m oryx_tpu.cli batch --conf myapp.conf
+    python -m oryx_tpu.cli broker --port 2181 --dir /var/oryx/topics
     python -m oryx_tpu.cli topic-tail --conf myapp.conf --which update
     echo "a b c" | python -m oryx_tpu.cli topic-input --conf myapp.conf
 """
@@ -84,17 +87,78 @@ def cmd_topic_setup(config, args) -> int:
 
 
 def cmd_topic_tail(config, args) -> int:
-    """Stream a topic's messages to stdout (oryx-run.sh kafka-tail)."""
+    """Stream a topic's messages to stdout (oryx-run.sh kafka-tail).
+    ``--max-messages N`` exits after N messages instead of tailing forever
+    (scriptable inspection; the tcp smoke tests ride this)."""
+    remaining = args.max_messages
+    if remaining is not None and remaining <= 0:
+        return 0  # nothing asked for: exit before the blocking iterator
     broker_url, name = _topics(config)[args.which]
     broker = tp.get_broker(broker_url)
     it = tp.ConsumeDataIterator(broker, name, "earliest")
     try:
         for km in it:
             print(f"{km.key}\t{km.message}", flush=True)
+            if remaining is not None:
+                remaining -= 1
+                if remaining <= 0:
+                    break
     except KeyboardInterrupt:
         pass
     finally:
         it.close()
+    return 0
+
+
+def cmd_broker(argv: "list[str]") -> int:
+    """Run the ``tcp:`` network broker server (transport/netbroker.py): one
+    process owns ``--dir`` durably (wrapping the file broker locally — the
+    single-writer design that retires the shared-FS constraint) and serves
+    it to any number of hosts on ``--port``. Foreground; SIGTERM/SIGINT
+    stop it cleanly. Runbook: docs/admin.md "Broker selection"."""
+    import threading
+
+    parser = argparse.ArgumentParser(
+        prog="oryx-run broker", description="Oryx TCP broker server"
+    )
+    parser.add_argument("--port", type=int, required=True,
+                        help="TCP port to listen on (0 = ephemeral)")
+    parser.add_argument("--dir", required=True,
+                        help="topic storage directory this server owns")
+    parser.add_argument("--host", default=None,
+                        help="bind host (default: oryx.broker.tcp.server.host)")
+    parser.add_argument("--group-ttl-sec", type=float, default=None,
+                        help="consumer-group heartbeat TTL (default 30)")
+    parser.add_argument("--conf", help="HOCON config file overlaid on defaults")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    config = _load_config(args.conf)
+    from oryx_tpu.transport import netbroker
+
+    netbroker.configure(config)
+    server_cfg = config.get_config("oryx.broker.tcp.server")
+    host = args.host or server_cfg.get_string("host", "0.0.0.0")
+    stats_interval = server_cfg.get_float("stats-interval-sec", 60.0)
+    server = netbroker.NetBrokerServer(
+        args.dir, host=host, port=args.port,
+        group_ttl_sec=args.group_ttl_sec,
+        stats_interval_sec=stats_interval,
+    )
+    server.start_background()
+    print(f"broker listening on {host}:{server.port} dir={args.dir}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        while not stop.wait(3600):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
     return 0
 
 
@@ -121,6 +185,10 @@ def main(argv: "list[str] | None" = None) -> int:
         from oryx_tpu.tools.analyze.cli import main as analyze_main
 
         return analyze_main(args_in[1:])
+    if args_in and args_in[0] == "broker":
+        # the tcp broker server is a pure-transport process: its own option
+        # surface (--port/--dir/...), and it must never pay a jax import
+        return cmd_broker(args_in[1:])
     parser = argparse.ArgumentParser(
         prog="oryx-run", description="Oryx TPU runner (oryx-run.sh equivalent)"
     )
@@ -132,6 +200,10 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument(
         "--which", choices=["input", "update"], default="update",
         help="which topic for topic-tail",
+    )
+    parser.add_argument(
+        "--max-messages", type=int, default=None,
+        help="topic-tail: exit after this many messages (default: tail forever)",
     )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
@@ -147,6 +219,11 @@ def main(argv: "list[str] | None" = None) -> int:
 
         jax.config.update("jax_platforms", env_platforms)
     config = _load_config(args.conf)
+    # the topic tools talk to brokers directly (no layer construction runs
+    # configure for them): adopt oryx.broker.tcp.* before any get_broker
+    from oryx_tpu.transport import netbroker
+
+    netbroker.configure(config)
     if args.command == "batch":
         return _run_layer("oryx_tpu.lambda_rt.batch.BatchLayer", config)
     if args.command == "speed":

@@ -47,6 +47,34 @@ oryx = {
     }
   }
 
+  # Network broker (transport/netbroker.py): point any *-topic.broker at
+  # "tcp://host:port" of a `python -m oryx_tpu.cli broker --port N --dir D`
+  # process — the single writer that owns the topic directory — and tiers
+  # on any host share topics with no shared filesystem (docs/admin.md
+  # "Broker selection"). These knobs shape the tcp CLIENT (adopted
+  # process-wide by netbroker.configure, the resilience idiom) and the
+  # server process.
+  broker = {
+    tcp = {
+      # TCP connect budget for a client's first (or reconnect) dial.
+      connect-timeout-sec = 10
+      # Per-RPC socket budget; a broker that answers slower than this
+      # surfaces as a transient error and rides the retry policy.
+      request-timeout-sec = 30
+      # Frame-size ceiling both directions (matches the transport-level
+      # MAX_REQUEST_SIZE of 1<<26; oversize requests fail typed, locally).
+      max-frame-bytes = 67108864
+      server = {
+        # Bind host for `cli broker` (--host overrides).
+        host = "0.0.0.0"
+        # Cadence of the server's one-line stats log (connections, frames,
+        # bytes); 0 disables. Full counters are in the process metrics
+        # registry, scrapeable over the wire via the `metrics` RPC.
+        stats-interval-sec = 60
+      }
+    }
+  }
+
   # Default compute-tier settings shared by batch and speed
   # (replaces oryx.default-streaming-config Spark knobs).
   default-compute-config = {
@@ -118,6 +146,18 @@ oryx = {
     # serves a stale model; the lag gate lets a balancer rotate the replica
     # out). 0 disables the lag check; model-loaded is always required.
     ready-max-lag-sec = 600
+    # Where the update consumer starts (and resumes after a crash or a
+    # kill -9): "earliest" (reference parity — full replay rebuilds the
+    # model from the topic head) or "committed" (offset-keyed resume: the
+    # layer commits each partition's position AFTER the manager processed
+    # the message, keyed by oryx.id in the broker's offset store, and a
+    # restarted replica continues from there instead of replaying the
+    # topic). Delivery is at-least-once: a crash between applying a
+    # message and the next commit re-delivers that message on restart, so
+    # "committed" requires oryx.id AND a manager whose apply is idempotent
+    # and whose state survives restarts (tests/fleet_app.py dedupes by
+    # sequence number — that pattern). Nothing is ever lost or skipped.
+    update-resume = "earliest"
     no-init-topics = false
     # Shard the item-factor matrix over all local devices so Y can exceed
     # one chip's memory; top-N becomes per-shard top-k + cross-shard merge.

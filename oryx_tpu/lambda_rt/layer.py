@@ -23,6 +23,7 @@ from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
+from oryx_tpu.transport import netbroker
 from oryx_tpu.transport import topic as tp
 
 log = spans.get_logger(__name__)
@@ -62,6 +63,7 @@ class AbstractLayer:
         compilecache.configure(config)
         resilience.configure(config)
         faults.configure(config)
+        netbroker.configure(config)  # tcp:// client timeouts/frame caps
         # trainer cost accounting + memory gauges report through the same
         # /metrics surface as serving replicas (scraped or snapshotted by
         # bench_batch) — peaks and gauges configure here too
@@ -151,17 +153,10 @@ class AbstractLayer:
                 )
 
     def _offset_op(self, fn):
-        """One offset-store read/write, retried through transient failures
-        (the control plane rides the same flaky filesystem as the data)."""
-
-        def _do():
-            faults.maybe_fail("broker.offset")
-            return fn()
-
-        return resilience.default_policy().call(
-            "broker.offset", _do, retryable=tp.transient_transport_error,
-            stop=self._stop,
-        )
+        """One offset-store read/write under the shared transport retry
+        contract (tp.offset_op — the same wrapper the serving layer's
+        committed-resume commits ride)."""
+        return tp.offset_op(fn, stop=self._stop)
 
     # -- microbatch pump ----------------------------------------------------
     def run_microbatches(

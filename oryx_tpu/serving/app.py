@@ -35,7 +35,13 @@ from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
-from oryx_tpu.transport.topic import ConsumeDataIterator, TopicProducerImpl, get_broker
+from oryx_tpu.transport import netbroker
+from oryx_tpu.transport.topic import (
+    ConsumeDataIterator,
+    TopicProducerImpl,
+    get_broker,
+    offset_op as tp_offset_op,
+)
 
 log = spans.get_logger(__name__)
 
@@ -177,7 +183,13 @@ def _lag_messages_fn(metered_ref):
         if metered is None:
             return 0.0
         try:
-            lag = metered._broker.total_size(metered._topic) - metered._consumed
+            # lag from the iterator's own read positions, not a consumed
+            # count: a "committed" consumer starts mid-topic, so
+            # total - consumed would report the whole history as backlog
+            # forever on a healthy caught-up replica
+            lag = metered._iterator.messages_behind(
+                metered._broker.total_size(metered._topic)
+            )
         except Exception:  # noqa: BLE001  # analyze: ignore[swallowed-exception] -- scrape-time lag probe is advisory; a log line per scrape would flood
             return 0.0
         return float(max(0, lag))
@@ -195,11 +207,21 @@ class _MeteredUpdates:
 
     ``broker`` must be the SAME instance the iterator consumes from (for
     ``file:`` brokers a fresh instance would rebuild a duplicate line index
-    just to answer total_size)."""
+    just to answer total_size).
 
-    def __init__(self, updates, broker, topic: str):
+    ``commit`` (optional, the ``update-resume = "committed"`` path) runs at
+    the TOP of each ``__next__`` — the moment the manager asks for more is
+    the proof it finished the previous message, which is exactly when
+    UpdateOffsetsFn semantics say the position may be persisted. A commit
+    that ran any earlier could lose a generation to a crash mid-apply."""
+
+    def __init__(self, updates, broker, topic: str, commit=None):
         import weakref
 
+        # the raw ConsumeDataIterator: the lag gauge reads its per-partition
+        # positions (messages_behind), which stay truthful in BOTH resume
+        # modes — a consumed count would misread "committed" starts
+        self._iterator = updates
         # trace continuation: a consumed message bearing a traceparent header
         # is processed under a span continuing the trace minted at ingress
         # (the span closes when the manager asks for the next message)
@@ -209,6 +231,7 @@ class _MeteredUpdates:
         ))
         self._broker = broker
         self._topic = topic
+        self._commit = commit
         self._consumed = 0
         # baseline at consumer start: "seconds since progress" must grow for
         # a consumer that wedges before its FIRST message, not read 0 forever
@@ -224,6 +247,11 @@ class _MeteredUpdates:
         return self
 
     def __next__(self):
+        # offset-keyed resume: persist the position past everything already
+        # processed (BEFORE the chaos hook — an injected consumer crash
+        # must never un-commit finished work)
+        if self._commit is not None:
+            self._commit()
         # chaos hook: an armed "serving.update_consume" schedule crashes the
         # consumer HERE, through the exact path a poison update or broker
         # fault would take (the supervised restart loop absorbs it)
@@ -295,6 +323,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     compilecache.configure(config)
     resilience.configure(config)
     faults.configure(config)
+    netbroker.configure(config)  # tcp:// client timeouts/frame caps
     # roofline peaks + device-memory gauges + the profiler session config
     # (after the others: jax is imported by now, so peak auto-detection and
     # per-device gauge wiring can see the live backend)
@@ -675,12 +704,31 @@ class ServingLayer:
 
     def __init__(self, config):
         self.config = config
+        # tcp client knobs must be adopted BEFORE the first get_broker()
+        # (start() resolves brokers well before make_app re-configures)
+        netbroker.configure(config)
         self.id = config.get_string("oryx.id", None)
         self.update_broker = config.get_string("oryx.update-topic.broker")
         self.update_topic = config.get_string("oryx.update-topic.message.topic")
         self.input_broker = config.get_string("oryx.input-topic.broker")
         self.input_topic = config.get_string("oryx.input-topic.message.topic")
         self.read_only = config.get_bool("oryx.serving.api.read-only", False)
+        # "earliest" (reference parity: full replay) or "committed"
+        # (offset-keyed resume: commit after processing, restart from the
+        # stored position — the multi-host fleet's cheap-restart mode)
+        self.update_resume = config.get_string(
+            "oryx.serving.update-resume", "earliest"
+        )
+        if self.update_resume not in ("earliest", "committed"):
+            raise ValueError(
+                f"oryx.serving.update-resume must be 'earliest' or "
+                f"'committed', not {self.update_resume!r}"
+            )
+        if self.update_resume == "committed" and not self.id:
+            raise ValueError(
+                "oryx.serving.update-resume='committed' requires oryx.id "
+                "(it keys this replica's stored offsets)"
+            )
         # TLS listens on secure-port, plaintext on port — the reference's
         # connector split (ServingLayer.makeConnector:202-255); before this
         # the secure-port key was declared but never read (oryx-analyze:
@@ -719,12 +767,39 @@ class ServingLayer:
             producer = TopicProducerImpl(self.input_broker, self.input_topic)
         self.manager = self._load_manager()
         update_broker = get_broker(self.update_broker)
-        self._update_iterator = ConsumeDataIterator(
-            update_broker, self.update_topic, "earliest"
-        )
-        self._metered_updates = _MeteredUpdates(
-            self._update_iterator, update_broker, self.update_topic
-        )
+        offset_group = f"serving-{self.id}" if self.id else None
+        committed_mode = self.update_resume == "committed"
+        last_committed: dict[int, int] = {}
+
+        def _commit_processed():
+            # persist only positions that moved since the last commit; the
+            # PROCESSED offsets, never the read positions (the prefetch
+            # buffer may hold messages the manager has not applied yet).
+            # tp.offset_op is the shared commit-path retry contract (site
+            # broker.offset, same as the lambda tiers' UpdateOffsetsFn path)
+            for p, off in self._update_iterator.processed_offsets.items():
+                if last_committed.get(p) != off:
+                    tp_offset_op(
+                        lambda p=p, off=off: update_broker.set_offset(
+                            offset_group, self.update_topic, off, p
+                        ),
+                        stop=self._stopped,
+                    )
+                    last_committed[p] = off
+
+        def _new_update_pipeline():
+            iterator = ConsumeDataIterator(
+                update_broker, self.update_topic,
+                "committed" if committed_mode else "earliest",
+                offset_group=offset_group,
+            )
+            metered = _MeteredUpdates(
+                iterator, update_broker, self.update_topic,
+                commit=_commit_processed if committed_mode else None,
+            )
+            return iterator, metered
+
+        self._update_iterator, self._metered_updates = _new_update_pipeline()
         restart_cfg = self.config.get_config("oryx.resilience.consumer-restart")
         max_restarts = restart_cfg.get_int("max-restarts", -1)
         base_delay = restart_cfg.get_float("base-delay-ms", 100.0) / 1000.0
@@ -766,16 +841,16 @@ class ServingLayer:
                     delay = min(max_delay, base_delay * (2 ** (restarts - 1)))
                     log.exception(
                         "update consumer crashed (restart %d); restarting "
-                        "from earliest in %.2fs", restarts, delay,
+                        "from %s in %.2fs", restarts, self.update_resume,
+                        delay,
                     )
                     if self._stopped.wait(delay):
                         return
                     ioutils.close_quietly(self._update_iterator)
-                    self._update_iterator = ConsumeDataIterator(
-                        update_broker, self.update_topic, "earliest"
-                    )
-                    self._metered_updates = _MeteredUpdates(
-                        self._update_iterator, update_broker, self.update_topic
+                    # committed mode restarts from the stored positions
+                    # (offset-keyed resume); earliest mode replays in full
+                    self._update_iterator, self._metered_updates = (
+                        _new_update_pipeline()
                     )
                     # loop re-checks _stopped before consuming again, so a
                     # close() racing the rebuild cannot strand a consumer
@@ -867,3 +942,8 @@ class ServingLayer:
             and self._consumer_thread is not threading.current_thread()
         ):
             self._consumer_thread.join(timeout=5)
+        # this layer armed the process-global warmup state at start; a
+        # closed layer must not keep gating /readyz of whatever serves
+        # next in this process (an armed-but-dead state read "cold"
+        # forever and 503'd later bare make_app() apps)
+        compilecache.warmup_state().reset()

@@ -12,6 +12,10 @@ when reachable from an ``async def``:
     ``AutoLock``/``AutoReadWriteLock`` handles
   * ``<*producer*>.send(...)`` — the topic producer's send does file I/O
     under the broker lock on ``file:`` brokers
+  * raw socket I/O: ``socket.create_connection`` and
+    ``<*sock*>.{connect,recv,sendall}`` — the tcp broker hazard class: the
+    netbroker server/``cli broker`` event loop must reach sockets only
+    through asyncio streams (or the sync client, which runs on threads)
 
 Reachability is a project-wide call graph over resolvable calls (module
 functions, ``from``-imports, ``module.fn``, ``self.method``), so a handler
@@ -41,7 +45,12 @@ _BLOCKING_RESOLVED = {
     "subprocess.check_call": "subprocess.check_call blocks the event loop",
     "subprocess.check_output": "subprocess.check_output blocks the event loop",
     "jax.device_get": "jax.device_get is a synchronous device fetch",
+    "socket.create_connection": "socket.create_connection blocks the event "
+                                "loop (use asyncio.open_connection)",
 }
+
+#: Methods that block on a raw socket when the receiver is named like one.
+_BLOCKING_SOCKET_METHODS = {"connect", "recv", "sendall"}
 
 _BLOCKING_OS = {
     "open", "remove", "rename", "replace", "fsync", "makedirs", "listdir",
@@ -172,6 +181,14 @@ class BlockingAsyncChecker:
                     return (node.lineno, f"`{ast.unparse(node.func)}()` acquires a thread lock")
                 if attr == "block_until_ready":
                     return (node.lineno, "`.block_until_ready()` waits on the device")
+                if attr in _BLOCKING_SOCKET_METHODS and any(
+                    "sock" in s for s in recv_l
+                ):
+                    return (
+                        node.lineno,
+                        f"`{ast.unparse(node.func)}()` does synchronous "
+                        "socket I/O (use asyncio streams on the event loop)",
+                    )
                 if attr == "send" and any("producer" in s for s in recv_l):
                     return (
                         node.lineno,

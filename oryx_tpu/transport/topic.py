@@ -40,6 +40,7 @@ import json
 import os
 import threading
 import time
+import uuid
 import zlib
 from pathlib import Path
 from typing import Iterator
@@ -87,6 +88,23 @@ def transient_transport_error(exc: BaseException) -> bool:
     if isinstance(exc, TopicException):
         return exc.transient
     return isinstance(exc, OSError)
+
+
+def offset_op(fn, stop: "threading.Event | None" = None):
+    """One offset-store read/write under the transport retry contract:
+    fault site ``broker.offset``, transient failures retried by the process
+    policy. THE shared commit-path wrapper — the lambda tiers, the serving
+    layer's committed-resume loop, and the consumer's stored-offset lookup
+    all ride this one definition, so the retry contract cannot silently
+    diverge between tiers."""
+
+    def _do():
+        faults.maybe_fail("broker.offset")
+        return fn()
+
+    return resilience.default_policy().call(
+        "broker.offset", _do, retryable=transient_transport_error, stop=stop,
+    )
 
 
 #: Seconds after which a consumer-group member with no heartbeat is dropped
@@ -143,10 +161,16 @@ class Broker:
     def num_partitions(self, name: str) -> int:
         raise NotImplementedError
 
-    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
+    def append(self, topic: str, key, message, headers: "dict | None" = None,
+               token: "str | None" = None) -> None:
         """Route by key hash to a partition and append (None key round-robins).
         ``headers`` is transport metadata delivered back on the KeyMessage
-        (trace context rides here, never inside the payload)."""
+        (trace context rides here, never inside the payload). ``token`` is an
+        optional idempotence token: retry wrappers pass ONE token per logical
+        send, and a broker MAY dedup repeated appends bearing it (the tcp
+        broker does — a retry after a lost response must not double-append).
+        In-process/file brokers ignore it: their 'failed' appends never
+        applied, so retries are naturally safe."""
         raise NotImplementedError
 
     def read(
@@ -201,10 +225,15 @@ class Broker:
 
 _memory_brokers: dict[str, "MemoryBroker"] = {}
 _memory_lock = threading.Lock()
+_tcp_clients: dict[str, Broker] = {}
+_tcp_lock = threading.Lock()
 
 
 def get_broker(url: str) -> Broker:
-    """Resolve a broker from a config URL: ``memory:[name]`` or ``file:<dir>``."""
+    """Resolve a broker from a config URL: ``memory:[name]`` (in-process),
+    ``file:<dir>`` (shared-filesystem durable log), or ``tcp://host:port``
+    (network broker server — transport/netbroker.py; docs/admin.md has the
+    selection guide)."""
     if url.startswith("memory:"):
         name = url[len("memory:"):] or "default"
         with _memory_lock:
@@ -212,6 +241,17 @@ def get_broker(url: str) -> Broker:
             if b is None:
                 b = _memory_brokers[name] = MemoryBroker()
             return b
+    if url.startswith("tcp://"):
+        # one shared client per URL: threads each get their own socket
+        # inside it, and every producer/consumer in the process reuses the
+        # same connection pool instead of minting new ones per component
+        from oryx_tpu.transport import netbroker
+
+        with _tcp_lock:
+            c = _tcp_clients.get(url)
+            if c is None:
+                c = _tcp_clients[url] = netbroker.client_from_url(url)
+            return c
     if url.startswith("file:"):
         return FileBroker(url[len("file:"):])
     raise TopicException(f"unknown broker url: {url}")
@@ -221,6 +261,12 @@ def reset_memory_brokers() -> None:
     """Drop all in-process brokers (test isolation)."""
     with _memory_lock:
         _memory_brokers.clear()
+
+
+def reset_tcp_clients() -> None:
+    """Drop cached tcp clients (test isolation across server restarts)."""
+    with _tcp_lock:
+        _tcp_clients.clear()
 
 
 class _MemoryPartition:
@@ -254,11 +300,15 @@ class MemoryBroker(Broker):
                 raise TopicException(f"topic does not exist: {name}")
             return t
 
-    def _partition(self, name: str, partition: int) -> _MemoryPartition:
+    def _partition(self, name: str, partition: int) -> "tuple[_MemoryTopic, _MemoryPartition]":
+        """Topic + bounds-checked partition. Every partitioned accessor
+        routes through here so an out-of-range partition raises a TYPED
+        TopicException, never a bare IndexError — the tcp server maps these
+        onto the wire as typed errors, not stack traces."""
         t = self._topic(name)
         if not 0 <= partition < len(t.partitions):
             raise TopicException(f"no partition {partition} in topic {name}")
-        return t.partitions[partition]
+        return t, t.partitions[partition]
 
     def create_topic(self, name: str, partitions: int = 1) -> None:
         with self._lock:
@@ -275,7 +325,8 @@ class MemoryBroker(Broker):
     def num_partitions(self, name: str) -> int:
         return len(self._topic(name).partitions)
 
-    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
+    def append(self, topic: str, key, message, headers: "dict | None" = None,
+               token: "str | None" = None) -> None:
         t = self._topic(topic)
         with t.cond:
             p = partition_for_key(key, len(t.partitions), next(t.rr))
@@ -285,16 +336,14 @@ class MemoryBroker(Broker):
     def read(
         self, topic: str, offset: int, max_items: int = 1024, partition: int = 0
     ) -> list[KeyMessage]:
-        t = self._topic(topic)
+        t, part = self._partition(topic, partition)
         with t.cond:
-            part = t.partitions[partition]
             lo = max(offset - part.base, 0)
             return part.log[lo:lo + max_items]
 
     def size(self, topic: str, partition: int = 0) -> int:
-        t = self._topic(topic)
+        t, part = self._partition(topic, partition)
         with t.cond:
-            part = t.partitions[partition]
             return part.base + len(part.log)
 
     def total_size(self, topic: str) -> int:
@@ -303,9 +352,8 @@ class MemoryBroker(Broker):
             return sum(p.base + len(p.log) for p in t.partitions)
 
     def truncate(self, topic: str, before_offset: int, partition: int = 0) -> None:
-        t = self._topic(topic)
+        t, part = self._partition(topic, partition)
         with t.cond:
-            part = t.partitions[partition]
             drop = min(max(before_offset - part.base, 0), len(part.log))
             if drop:
                 del part.log[:drop]
@@ -395,7 +443,16 @@ class FileBroker(Broker):
             raise TopicException(f"topic does not exist: {name}")
         return max(1, len(list(d.glob("[0-9]*.jsonl"))))
 
-    def append(self, topic: str, key, message, headers: "dict | None" = None) -> None:
+    def append(self, topic: str, key, message, headers: "dict | None" = None,
+               token: "str | None" = None) -> None:
+        if isinstance(message, (bytes, bytearray)):
+            # the JSONL record format carries str payloads only; fail TYPED
+            # (and permanent) instead of leaking json.dumps's TypeError —
+            # memory: accepts bytes, but anything durable/wire must not
+            raise TopicException(
+                "bytes messages are not supported by the file:/tcp: "
+                "brokers (JSON record format); encode to str first"
+            )
         n_parts = self.num_partitions(topic)
         part = partition_for_key(key, n_parts, next(self._rr))
         p = self._log_path(topic, part)
@@ -574,13 +631,26 @@ class TopicProducerImpl:
         # as a traceparent header (W3C format), so a trace minted at HTTP
         # ingress crosses the topic hop into whichever tier consumes this
         headers = spans.inject_headers(headers)
+        # ONE idempotence token per logical send, OUTSIDE the retry: a
+        # network broker that applied the append but lost the response
+        # dedups the retried attempt instead of double-appending
+        token = uuid.uuid4().hex
 
         def _append():
             faults.maybe_fail("broker.append")
-            self._broker.append(self._topic, key, message, headers)
+            self._broker.append(self._topic, key, message, headers,
+                                token=token)
 
         try:
-            if self._max_size is not None and isinstance(message, str) and len(message) > self._max_size:
+            # bytes payloads must honor the cap exactly like str ones — the
+            # str-only check let arbitrarily large bytes blobs bypass the
+            # transport limit entirely (and blow the tcp broker's frame cap
+            # downstream instead of failing typed at the producer)
+            if (
+                self._max_size is not None
+                and isinstance(message, (str, bytes, bytearray))
+                and len(message) > self._max_size
+            ):
                 raise TopicException(
                     f"message of {len(message)} bytes exceeds max {self._max_size}"
                 )
@@ -606,16 +676,25 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
     exponential poll backoff 1→1000 ms and wakeup-based close
     (kafka-util/.../ConsumeDataIterator.java:30-77).
 
-    ``start_offset``: "earliest" (0), "latest" (current end), an int (only
-    valid when consuming exactly one partition), or a {partition: offset}
-    dict. ``partitions`` restricts consumption to a fixed subset; ``group``
-    joins a consumer group instead — the broker's live membership splits the
-    topic's partitions round-robin (partitions_for_member), re-evaluated every
-    poll so consumers that join/leave rebalance without a coordinator.
+    ``start_offset``: "earliest" (0), "latest" (current end), "committed"
+    (per-partition positions stored in the broker's offset store under
+    ``offset_group`` — falling back to ``group`` — looked up LAZILY when a
+    partition is first touched, so partitions acquired mid-flight by a
+    rebalance resume from the group's committed position instead of
+    re-delivering from 0), an int (only valid when consuming exactly one
+    partition), or a {partition: offset} dict. ``partitions`` restricts
+    consumption to a fixed subset; ``group`` joins a consumer group instead
+    — the broker's live membership splits the topic's partitions
+    round-robin (partitions_for_member), re-evaluated every poll so
+    consumers that join/leave rebalance without a coordinator.
 
     Offset *persistence* is deliberately not done here: layers commit consumed
     positions after processing (UpdateOffsetsFn semantics) via
-    Broker.set_offset.
+    Broker.set_offset. Commit :attr:`processed_offsets` — the position past
+    the last message HANDED OUT — never :attr:`offsets` (the read position,
+    which runs ahead of processing by whatever sits in the prefetch buffer;
+    committing it would silently skip buffered-but-unprocessed messages on
+    a crash-resume).
     """
 
     _MIN_BACKOFF = 0.001
@@ -630,6 +709,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         partitions: "list[int] | None" = None,
         group: "str | None" = None,
         member_id: "str | None" = None,
+        offset_group: "str | None" = None,
     ):
         self._broker = get_broker(broker) if isinstance(broker, str) else broker
         self._topic = topic
@@ -641,6 +721,7 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             self._broker.join_group(group, topic, self._member_id)
         self._last_heartbeat = time.monotonic()
         self._start = start_offset
+        self._offset_group = offset_group if offset_group is not None else group
         self._offsets: dict[int, int] = {}
         if isinstance(start_offset, dict):
             self._offsets.update({int(p): int(o) for p, o in start_offset.items()})
@@ -650,6 +731,15 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             # is slow to schedule
             for p in range(self._n_parts):
                 self._offsets[p] = self._broker.size(topic, p)
+        elif start_offset == "committed":
+            # positions resolve lazily per partition in _offset_of, so a
+            # partition inherited from a dead group member resumes from the
+            # group's committed offset, not from 0
+            if not self._offset_group:
+                raise TopicException(
+                    "start_offset='committed' needs an offset_group (or "
+                    "group) naming the stored positions"
+                )
         elif start_offset != "earliest":
             static = partitions if partitions is not None else list(range(self._n_parts))
             if group is None and len(static) == 1:
@@ -661,7 +751,10 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                     "int start_offset is ambiguous over multiple partitions; "
                     "pass a {partition: offset} dict"
                 )
-        self._buffer: list[KeyMessage] = []
+        # prefetched messages with provenance: (message, partition, offset
+        # AFTER this message) — __next__ pops one and advances _processed
+        self._buffer: list[tuple[KeyMessage, int, int]] = []
+        self._processed: dict[int, int] = {}
         self._closed = threading.Event()
 
     # -- partition assignment -------------------------------------------------
@@ -675,13 +768,41 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             assigned = partitions_for_member(self._member_id, members, self._n_parts)
             if self._partitions is not None:
                 assigned = [p for p in assigned if p in self._partitions]
+            # rebalance hygiene: a partition lost to another member leaves
+            # no residue — a stale _processed entry would let this member's
+            # commit loop clobber the new owner's (higher) committed offset,
+            # and in committed mode a stale read position would shadow the
+            # store's offset if the partition ever came back
+            for p in [p for p in self._processed if p not in assigned]:
+                del self._processed[p]
+            if self._start == "committed":
+                for p in [p for p in self._offsets if p not in assigned]:
+                    del self._offsets[p]
             return assigned
         if self._partitions is not None:
             return list(self._partitions)
         return list(range(self._n_parts))
 
     def _offset_of(self, partition: int) -> int:
-        return self._offsets.setdefault(partition, 0)
+        off = self._offsets.get(partition)
+        if off is None:
+            if self._start == "committed":
+                stored = self._stored_offset(partition)
+                off = stored if stored is not None else 0
+            else:
+                off = 0
+            self._offsets[partition] = off
+        return off
+
+    def _stored_offset(self, partition: int) -> "int | None":
+        """Committed position lookup (first touch of a partition in
+        "committed" mode) — the shared offset-op retry contract."""
+        return offset_op(
+            lambda: self._broker.get_offset(
+                self._offset_group, self._topic, partition
+            ),
+            stop=self._closed,
+        )
 
     def _read_with_retry(self, partition: int, offset: int) -> list:
         """One partition poll, retried through transient broker failures
@@ -704,7 +825,36 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
 
     @property
     def offsets(self) -> dict[int, int]:
+        """READ positions (they run ahead of processing by the prefetch
+        buffer — commit :attr:`processed_offsets`, not these)."""
         return dict(self._offsets)
+
+    @property
+    def processed_offsets(self) -> dict[int, int]:
+        """Per-partition position past the last message HANDED OUT by
+        ``__next__`` — the safe value for after-processing offset commits
+        (UpdateOffsetsFn semantics): resuming from it neither re-delivers a
+        processed message nor skips a prefetched-but-unprocessed one.
+        Partitions lost to a group rebalance drop out on the next poll, so
+        a commit loop writing these wholesale never clobbers the new
+        owner's position."""
+        return dict(self._processed)
+
+    def messages_behind(self, total: int) -> int:
+        """Advisory consumer lag against a topic-total snapshot: messages
+        not yet handed out (read positions rolled back by the prefetch
+        buffer). Correct in every start mode — a "committed" consumer's
+        positions resolve on its first poll, so a caught-up restarted
+        replica reads ~0 here, not the topic length. Before the first poll
+        (no positions resolved) this reads 0: the backlog is unknown, and
+        a replica that has not polled yet is covered by the lag-seconds
+        gauge, not this one. A CLOSED iterator reads 0: it is being torn
+        down (its supervised replacement re-registers the gauges), and a
+        stale scrape callback must not report a dead pipeline's backlog."""
+        if self._closed.is_set() or not self._offsets:
+            return 0
+        read = sum(self._offsets.values())
+        return max(0, int(total) - read + len(self._buffer))
 
     def __iter__(self) -> "ConsumeDataIterator":
         return self
@@ -721,20 +871,32 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 if batch:
                     self._offsets[p] = off + len(batch)
                     self._buffer.extend(
-                        km for km in batch if km is not CORRUPT_RECORD
+                        (km, p, off + i + 1)
+                        for i, km in enumerate(batch)
+                        if km is not CORRUPT_RECORD
                     )
                     progressed = True
             if self._buffer:
                 break
             if progressed:
                 continue  # consumed only corrupt records; poll again
+            # total_size rides the retry policy too: an idle consumer must
+            # not crash (and in earliest mode trigger a full replay) because
+            # the broker blipped between two polls — the same contract the
+            # read path already has (no fault hook: this probe is advisory)
+            total = resilience.default_policy().call(
+                "broker.read",
+                lambda: self._broker.total_size(self._topic),
+                retryable=transient_transport_error, stop=self._closed,
+            )
             self._broker.wait_for_data(
-                self._topic, self._broker.total_size(self._topic), backoff,
-                stop=self._closed,
+                self._topic, total, backoff, stop=self._closed,
             )
             backoff = min(backoff * 2, self._MAX_BACKOFF)
         _CONSUMED.labels(self._topic).inc()
-        return self._buffer.pop(0)
+        km, p, next_off = self._buffer.pop(0)
+        self._processed[p] = next_off
+        return km
 
     def close(self) -> None:
         """Wake up and terminate a blocked iteration (consumer.wakeup())."""

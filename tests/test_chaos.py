@@ -10,6 +10,13 @@ zero request errors and zero sheds.
 
 Tests run IN ORDER against one shared pair (tier-1 runs with -p
 no:randomly); each phase arms its own schedule and disarms after itself.
+
+The whole suite runs TWICE: once on the in-process ``memory:`` broker and
+once against a live ``tcp:`` netbroker server — the second pass proves the
+fault sites (broker.append / serving.update_consume / device breaker) and
+every recovery behavior hold when the broker hop crosses a real network
+socket (retries re-send the RPC; the consumer restart rebuilds a tcp
+iterator; /readyz self-heals over the wire).
 """
 
 import concurrent.futures as cf
@@ -38,17 +45,31 @@ def _metric_line(text: str, name: str, label_frag: str) -> float:
     return 0.0
 
 
-@pytest.fixture(scope="module")
-def chaos_pair(tmp_path_factory):
+@pytest.fixture(scope="module", params=["memory", "tcp"])
+def chaos_pair(request, tmp_path_factory):
     from tests.test_serving import _publish_to_topic, _train_tiny
 
     tp.reset_memory_brokers()
+    tp.reset_tcp_clients()
     faults.disarm()
     tmp_path = tmp_path_factory.mktemp("chaos-model")
     port = ioutils.choose_free_port()
+    server = None
+    if request.param == "tcp":
+        from oryx_tpu.transport import netbroker
+
+        server = netbroker.NetBrokerServer(
+            str(tmp_path_factory.mktemp("chaos-broker")),
+            host="127.0.0.1", port=0,
+        ).start_background()
+        broker_url = f"tcp://127.0.0.1:{server.port}"
+    else:
+        broker_url = "memory:"
     config = cfg.overlay_on(
         {
             "oryx.id": "chaos-e2e",
+            "oryx.input-topic.broker": broker_url,
+            "oryx.update-topic.broker": broker_url,
             "oryx.serving.api.port": port,
             "oryx.serving.model-manager-class":
                 "oryx_tpu.models.als.serving.ALSServingModelManager",
@@ -69,7 +90,7 @@ def chaos_pair(tmp_path_factory):
     )
     tp.maybe_create_topics(config, "input-topic", "update-topic")
     pmml, batch, known = _train_tiny(tmp_path)
-    _publish_to_topic(pmml, tmp_path, known)
+    _publish_to_topic(pmml, tmp_path, known, broker_url)
 
     from oryx_tpu.lambda_rt.speed import SpeedLayer
 
@@ -92,18 +113,21 @@ def chaos_pair(tmp_path_factory):
     else:
         pytest.fail("serving layer never became ready")
     user = batch.users.index_to_id[0]
-    yield client, serving, speed, user
+    yield client, serving, speed, user, broker_url
     faults.disarm()
     client.close()
     speed.close()
     serving.close()
+    if server is not None:
+        server.close()
     tp.reset_memory_brokers()
+    tp.reset_tcp_clients()
 
 
 def test_chaos_broker_faults_drop_no_inflight_requests(chaos_pair):
     """broker.append fail-3-then-succeed under concurrent writes: the retry
     policy absorbs every injected failure — zero client-visible errors."""
-    client, serving, speed, user = chaos_pair
+    client, serving, speed, user, broker_url = chaos_pair
     base = str(client.base_url)
     recovered_before = _counter(
         "oryx_retries_total", 'site="broker.append",outcome="recovered"'
@@ -135,14 +159,14 @@ def test_chaos_update_consumer_crash_restarts_within_budget(chaos_pair):
     """One injected consumer crash: the supervised loop restarts it (replay
     from earliest), /readyz recovers, and the HTTP side keeps serving from
     the in-memory model the whole time."""
-    client, serving, speed, user = chaos_pair
+    client, serving, speed, user, broker_url = chaos_pair
     restarts_before = serving.consumer_restarts
     metric_before = _counter("oryx_serving_consumer_restarts_total")
     faults.arm("serving.update_consume=fail:1", seed=0)
     try:
         # wake the consumer with a fresh (ignorable) update — the fault
         # fires on its next __next__, crashing manager.consume
-        tp.TopicProducerImpl("memory:", "OryxUpdate").send(
+        tp.TopicProducerImpl(broker_url, "OryxUpdate").send(
             "UP", '["Y", "chaos-item", [0.0, 0.0, 0.0, 0.0]]'
         )
         deadline = time.monotonic() + 10
@@ -174,7 +198,7 @@ def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
     failed batch retries per-request, open-breaker traffic degrades to
     uncoalesced scans), and open → half_open → closed is observable in
     GET /metrics."""
-    client, serving, speed, user = chaos_pair
+    client, serving, speed, user, broker_url = chaos_pair
     degraded_before = _counter("oryx_breaker_degraded_requests_total")
     faults.arm("serving.device_call=fail:2", seed=0)
     try:
@@ -220,7 +244,7 @@ def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
 def test_chaos_warm_window_clean_after_disarm(chaos_pair):
     """Faults disarmed: a warm window of concurrent traffic records zero
     request errors and zero sheds (the recovered steady state)."""
-    client, serving, speed, user = chaos_pair
+    client, serving, speed, user, broker_url = chaos_pair
     faults.disarm()
     base = str(client.base_url)
     shed_before = _counter("oryx_shed_requests_total")

@@ -91,6 +91,67 @@ oryx {{
                 p.kill()
 
 
+def test_cli_topic_tools_over_tcp(tmp_path):
+    """The topic CLI tools are URL-scheme uniform: `topic-setup`,
+    `topic-input`, and `topic-tail` all work unchanged against a
+    `tcp://host:port` broker served by `python -m oryx_tpu.cli broker`
+    (the fleet runbook's smoke sequence, docs/admin.md)."""
+    broker_port = ioutils.choose_free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    conf = tmp_path / "app.conf"
+    conf.write_text(f"""
+oryx {{
+  id = "tcp-smoke"
+  input-topic.broker = "tcp://127.0.0.1:{broker_port}"
+  update-topic.broker = "tcp://127.0.0.1:{broker_port}"
+}}
+""")
+
+    def run_tool(cmd, *extra, stdin=None):
+        return subprocess.run(
+            [sys.executable, "-m", "oryx_tpu.cli", cmd,
+             "--conf", str(conf), *extra],
+            env=env, check=True, capture_output=True, text=True,
+            timeout=60, input=stdin, cwd=os.getcwd(),
+        )
+
+    broker_proc = subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu.cli", "broker",
+         "--port", str(broker_port), "--dir", str(tmp_path / "topics")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.getcwd(),
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                import socket
+
+                with socket.create_connection(
+                    ("127.0.0.1", broker_port), timeout=1
+                ):
+                    break
+            except OSError:
+                assert time.monotonic() < deadline, "broker never listened"
+                time.sleep(0.1)
+        setup = run_tool("topic-setup")
+        assert "created topic" in setup.stdout
+        # second setup is idempotent
+        assert "exists" in run_tool("topic-setup").stdout
+        run_tool("topic-input", stdin="hello world\nsecond line\n")
+        tail = run_tool("topic-tail", "--which", "input", "--max-messages", "2")
+        lines = tail.stdout.strip().splitlines()
+        assert [ln.split("\t", 1)[1] for ln in lines] == [
+            "hello world", "second line",
+        ]
+        # clean shutdown: SIGTERM stops the broker process
+        broker_proc.send_signal(signal.SIGTERM)
+        assert broker_proc.wait(timeout=20) is not None
+    finally:
+        if broker_proc.poll() is None:
+            broker_proc.kill()
+
+
 def test_cli_config_dump(tmp_path, capsys):
     from oryx_tpu.cli.main import main as cli_main
 

@@ -1,0 +1,252 @@
+"""Multi-host serving fleet IT over the ``tcp:`` network broker.
+
+The acceptance scenario of the netbroker subsystem (ROADMAP item 3): N
+serving replicas run as REAL subprocesses (``python -m oryx_tpu.cli
+serving``) consuming ONE update topic from a ``python -m oryx_tpu.cli
+broker`` server — no shared filesystem between them and the broker state —
+behind the ``/readyz`` gate. Traffic spreads across the fleet through
+tools/traffic.py (pinning the traffic generator against a tcp-backed
+fleet). One replica is ``kill -9``ed MID-STREAM while generations keep
+flowing, then restarted with the same ``oryx.id``: running
+``update-resume = "committed"`` it must resume from its broker-committed
+offset (not a full replay), recover ``/readyz`` on its own, and its durable
+generation ledger (tests/fleet_app.py) must read exactly 1..N each once —
+zero lost, zero duplicated generations.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import httpx
+import pytest
+
+from oryx_tpu.common import ioutils
+from oryx_tpu.transport import topic as tp
+
+N_REPLICAS = 3
+UPDATE_TOPIC = "OryxUpdate"
+GEN_INTERVAL_SEC = 0.025
+
+
+def _wait_tcp(port: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.1)
+    pytest.fail(f"nothing listening on 127.0.0.1:{port} after {timeout}s")
+
+
+def _replica_conf(tmp_path, rid: str, http_port: int, broker_url: str) -> str:
+    conf = tmp_path / f"{rid}.conf"
+    conf.write_text(f"""
+oryx {{
+  id = "{rid}"
+  input-topic.broker = "{broker_url}"
+  update-topic.broker = "{broker_url}"
+  serving {{
+    api.port = {http_port}
+    api.read-only = true
+    model-manager-class = "tests.fleet_app.FleetServingModelManager"
+    application-resources = "tests.fleet_app"
+    update-resume = "committed"
+  }}
+}}
+""")
+    return str(conf)
+
+
+def _spawn(cmd: list, env: dict) -> subprocess.Popen:
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.getcwd(),
+    )
+
+
+def _ledger(fleet_dir, rid: str) -> list:
+    path = fleet_dir / f"{rid}.ledger"
+    if not path.exists():
+        return []
+    return [int(line) for line in path.read_text().splitlines() if line]
+
+
+def _wait_ready(port: int, deadline_sec: float = 90.0) -> None:
+    deadline = time.monotonic() + deadline_sec
+    with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=10) as c:
+        while time.monotonic() < deadline:
+            try:
+                if c.get("/readyz").status_code == 200:
+                    return
+            except httpx.TransportError:
+                pass
+            time.sleep(0.25)
+    pytest.fail(f"replica on :{port} never reached /readyz 200")
+
+
+def test_fleet_kill9_offset_keyed_resume(tmp_path):
+    broker_port = ioutils.choose_free_port()
+    broker_dir = tmp_path / "broker"
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ORYX_FLEET_DIR=str(fleet_dir))
+    broker_url = f"tcp://127.0.0.1:{broker_port}"
+    http_ports = [ioutils.choose_free_port() for _ in range(N_REPLICAS)]
+    rids = [f"fleet-r{i}" for i in range(N_REPLICAS)]
+    procs: dict = {}
+    stop_publishing = threading.Event()
+    published = {"n": 0}
+
+    broker_proc = _spawn(
+        [sys.executable, "-m", "oryx_tpu.cli", "broker",
+         "--port", str(broker_port), "--dir", str(broker_dir)],
+        env,
+    )
+    try:
+        _wait_tcp(broker_port)
+        tp.reset_tcp_clients()
+        client = tp.get_broker(broker_url)
+        client.create_topic(UPDATE_TOPIC)
+        client.create_topic("OryxInput")
+
+        # continuous generation stream: each GEN is a complete model (like
+        # a MODEL push), seq starting at 1 == broker offset + 1
+        producer = tp.TopicProducerImpl(broker_url, UPDATE_TOPIC)
+
+        def publish():
+            while not stop_publishing.is_set():
+                seq = published["n"] + 1
+                producer.send("GEN", json.dumps(
+                    {"seq": seq, "words": {"gen": seq, "w": seq % 7}}
+                ))
+                published["n"] = seq
+                stop_publishing.wait(GEN_INTERVAL_SEC)
+
+        publisher = threading.Thread(target=publish, daemon=True)
+        publisher.start()
+
+        for rid, port in zip(rids, http_ports):
+            procs[rid] = _spawn(
+                [sys.executable, "-m", "oryx_tpu.cli", "serving",
+                 "--conf", _replica_conf(tmp_path, rid, port, broker_url)],
+                env,
+            )
+        for port in http_ports:
+            _wait_ready(port)
+
+        # fleet-wide traffic through the real traffic generator (pins
+        # tools/traffic.py against tcp-backed replicas): random host per
+        # request over all replicas, runs through the kill below
+        from oryx_tpu.tools import traffic
+
+        endpoint = traffic._Endpoint(
+            "state", 1.0, lambda rng: ("GET", "/fleet/state", None)
+        )
+        runner = traffic.TrafficRunner(
+            [f"127.0.0.1:{p}" for p in http_ports], [endpoint],
+            interval_ms=10.0, threads=2, duration_sec=120.0,
+        )
+        traffic_thread = threading.Thread(target=runner.run, daemon=True)
+        traffic_thread.start()
+
+        # let the victim apply a healthy prefix, then kill -9 MID-STREAM
+        # (the publisher never pauses)
+        victim = rids[1]
+        deadline = time.monotonic() + 60
+        while len(_ledger(fleet_dir, victim)) < 30:
+            assert time.monotonic() < deadline, "victim ledger never grew"
+            time.sleep(0.05)
+        procs[victim].send_signal(signal.SIGKILL)
+        assert procs[victim].wait(timeout=10) is not None
+
+        # survivors keep serving while the victim is down
+        for port in (http_ports[0], http_ports[2]):
+            with httpx.Client(
+                base_url=f"http://127.0.0.1:{port}", timeout=10
+            ) as c:
+                assert c.get("/fleet/state").status_code == 200
+
+        # let generations accumulate past the kill, then read the victim's
+        # committed offset — the position an offset-keyed resume must
+        # continue from
+        kill_seq = published["n"]
+        deadline = time.monotonic() + 30
+        while published["n"] < kill_seq + 20:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        off_at_restart = client.get_offset(f"serving-{victim}", UPDATE_TOPIC)
+        assert off_at_restart is not None and off_at_restart > 0, (
+            "victim committed no offsets before the kill"
+        )
+
+        # restart with the same oryx.id: /readyz must self-heal (snapshot
+        # restores the model before the first redelivered message)
+        procs[victim] = _spawn(
+            [sys.executable, "-m", "oryx_tpu.cli", "serving",
+             "--conf", _replica_conf(
+                 tmp_path, victim, http_ports[1], broker_url
+             )],
+            env,
+        )
+        _wait_ready(http_ports[1])
+
+        # stop the stream at N and wait for every replica to drain to it
+        stop_publishing.set()
+        publisher.join(timeout=10)
+        n_total = published["n"]
+        assert n_total > kill_seq + 20
+        deadline = time.monotonic() + 60
+        for rid in rids:
+            while True:
+                ledger = _ledger(fleet_dir, rid)
+                if ledger and ledger[-1] == n_total:
+                    break
+                assert time.monotonic() < deadline, (
+                    f"{rid} never drained to seq {n_total}: at "
+                    f"{ledger[-1] if ledger else 0}"
+                )
+                time.sleep(0.1)
+        runner.stop()
+        traffic_thread.join(timeout=15)
+
+        # THE acceptance assertion: exactly-once generation accounting
+        # across a kill -9 — zero lost, zero duplicated, in order
+        for rid in rids:
+            assert _ledger(fleet_dir, rid) == list(range(1, n_total + 1)), rid
+
+        # arithmetic proof the resume was offset-keyed, not a full replay:
+        # the restarted incarnation consumed exactly the messages past its
+        # committed offset
+        snap = json.loads((fleet_dir / f"{victim}.snapshot.json").read_text())
+        assert snap["incarnation_consumed"] == n_total - off_at_restart, (
+            snap, off_at_restart, n_total,
+        )
+
+        # the fleet served throughout: traffic flowed, and nothing answered
+        # a 5xx (the killed replica's downtime surfaces as connection
+        # errors, never as server errors)
+        assert runner.requests > 0
+        assert runner.server_errors == 0, (
+            f"{runner.server_errors} server errors under fleet traffic"
+        )
+
+        for rid in rids:
+            procs[rid].send_signal(signal.SIGTERM)
+        for rid in rids:
+            assert procs[rid].wait(timeout=20) is not None
+        producer.close()
+    finally:
+        stop_publishing.set()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if broker_proc.poll() is None:
+            broker_proc.kill()
+        tp.reset_tcp_clients()

@@ -41,8 +41,8 @@ def _train_tiny(tmp_path):
     return pmml, batch, known
 
 
-def _publish_to_topic(pmml, tmp_path, known):
-    prod = tp.TopicProducerImpl("memory:", "OryxUpdate")
+def _publish_to_topic(pmml, tmp_path, known, broker_url="memory:"):
+    prod = tp.TopicProducerImpl(broker_url, "OryxUpdate")
     prod.send("MODEL", pmmlutils.to_string(pmml))
     for id_, vec in pmml_codec.read_features(tmp_path / "Y"):
         prod.send("UP", json.dumps(["Y", id_, [float(v) for v in vec]]))

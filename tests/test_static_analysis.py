@@ -241,6 +241,61 @@ def test_blocking_async_propagates_through_project_calls():
     assert len(hits) == 1 and "send_line" in hits[0].message
 
 
+def test_blocking_async_fires_on_sync_socket_io_in_server_handler():
+    """The netbroker hazard class: an asyncio broker server (or any async
+    handler) reaching for the SYNC socket API — create_connection, or
+    connect/recv/sendall on a socket-named receiver — blocks the event
+    loop for every connected client."""
+    hits = _run(
+        """
+        import socket
+
+        async def handle(reader, writer):
+            upstream = socket.create_connection(("broker", 9092))
+
+        async def relay(self, sock, frame):
+            sock.sendall(frame)
+            return sock.recv(4)
+        """,
+        "blocking-async",
+    )
+    assert {f.symbol for f in hits} == {"handle", "relay"}
+    assert any("create_connection" in f.message for f in hits)
+    assert any("socket I/O" in f.message for f in hits)
+
+
+def test_blocking_async_quiet_on_netbroker_server_shape():
+    """The clean shape the real netbroker server uses: asyncio streams for
+    the wire, every blocking file op hopped off through asyncio.to_thread —
+    and the sync client's socket calls live in plain (threaded) defs."""
+    hits = _run(
+        """
+        import asyncio
+        import socket
+        import struct
+
+        _LEN = struct.Struct(">I")
+
+        async def handle(self, reader, writer):
+            head = await reader.readexactly(_LEN.size)
+            (length,) = _LEN.unpack(head)
+            body = await reader.readexactly(length)
+            result = await asyncio.to_thread(self._inner.append, body)
+            writer.write(_LEN.pack(len(body)) + body)
+            await writer.drain()
+            return result
+
+        def client_rpc(self, payload):
+            # sync client: runs on caller threads, never the loop
+            sock = socket.create_connection(("broker", 9092))
+            sock.sendall(payload)
+            return sock.recv(4)
+        """,
+        "blocking-async",
+    )
+    assert hits == []
+
+
 # ---------------------------------------------------------------------------
 # compile-on-hot-path
 # ---------------------------------------------------------------------------
@@ -618,6 +673,43 @@ def test_swallowed_exception_quiet_on_narrow_logged_or_reraised():
     """
     assert _run(silent, "swallowed-exception",
                 filename="oryx_tpu/tools/fixture.py") == []
+
+
+def test_swallowed_exception_fires_on_silent_async_server_catch():
+    """A broker-server-shaped silent catch: an async connection handler
+    that eats every failure without a log line would turn protocol bugs
+    into silently dropped RPCs — the transport tier is a hot path."""
+    src = """
+        async def _handle(self, reader, writer):
+            try:
+                frame = await self._read_frame(reader)
+            except Exception:
+                return None
+    """
+    hits = _run(src, "swallowed-exception",
+                filename="oryx_tpu/transport/fixture.py")
+    assert len(hits) == 1
+
+
+def test_swallowed_exception_quiet_on_netbroker_dispatch_shape():
+    """The real server's dispatch shape: broad catches are fine when the
+    failure degrades LOUDLY — logged and answered as a typed wire error
+    instead of a cut socket."""
+    src = """
+        from oryx_tpu.common import spans
+
+        log = spans.get_logger(__name__)
+
+        async def _dispatch(self, frame, op):
+            try:
+                result = await self._handlers[op](self, frame)
+                return {"ok": True, "result": result}
+            except Exception as e:
+                log.exception("netbroker op %s failed", op)
+                return {"ok": False, "error": str(e)}
+    """
+    assert _run(src, "swallowed-exception",
+                filename="oryx_tpu/transport/fixture.py") == []
 
 
 # ---------------------------------------------------------------------------
