@@ -259,8 +259,17 @@ def _serving_bench() -> dict:
         # host + device memory parity point — reference serving heap is
         # 1400 MB at 50f × 2M rows (BASELINE.md §heap); Y also lives
         # on-device here. Stable keys: trace_summary --history reads
-        # memory.host_peak_rss_mb round over round.
-        "memory": profiling.memory_snapshot(),
+        # memory.host_peak_rss_mb and memory.stores.* round over round.
+        "memory": {
+            **profiling.memory_snapshot(),
+            # dict-vs-arena host RSS + f32-vs-int8 device bytes, measured in
+            # clean subprocesses at the headline shape (6M rides --big)
+            "stores": _store_memory_section(N_ITEMS),
+            **(
+                {"stores_6m": _store_memory_section(6_000_000)}
+                if "--big" in sys.argv else {}
+            ),
+        },
         # which backend produced the number — a CPU-fallback figure
         # must never be mistaken for the TPU result
         "backend": jax.default_backend(),
@@ -278,6 +287,205 @@ def _serving_bench() -> dict:
         "slowest_traces": slowest_traces,
         "http": http_section,
     }
+
+
+def _store_memory_probe(variant: str, n: int, features: int) -> dict:
+    """One store-memory measurement in a CLEAN process (runs inside the
+    ``--store-memory`` subprocess): build ``n × features`` item factors
+    through ``variant`` and report the RSS the store itself cost.
+
+    Variants:
+      * ``dict``  — the pre-round-9 host store emulated faithfully: one
+        id → float32-ndarray dict entry per row (per-key Python/numpy
+        object overhead included);
+      * ``arena`` — the factor arena (one contiguous slab);
+      * ``device-float32`` / ``device-bfloat16`` / ``device-int8`` — a full
+        ALSServingModel at the given ``oryx.serving.device-dtype``,
+        reporting device-held factor bytes next to the host numbers.
+
+    Factors are GENERATED in chunks so the source matrix never sits next to
+    the finished store — the delta is the store's cost, not the harness's."""
+    import gc
+
+    from oryx_tpu.common.executils import get_used_memory
+
+    def trim():
+        """Return freed-but-retained heap to the OS before reading RSS:
+        glibc's dynamic mmap threshold keeps the probe's own transient
+        chunk buffers in the arena, which would be billed to the store."""
+        try:
+            import ctypes
+
+            ctypes.CDLL("libc.so.6").malloc_trim(0)
+        except Exception:  # noqa: BLE001 — non-glibc: RSS reads slightly high
+            pass
+
+    def reset_peak() -> None:
+        """Reset the kernel's RSS high-water mark (VmHWM) for THIS process.
+        Best-effort: a child forked from a fat parent (the test suite at
+        2+ GB) inherits the parent's resident peak at fork time, which
+        would read as a 30× 'store' peak."""
+        try:
+            with open("/proc/self/clear_refs", "w") as f:
+                f.write("5\n")
+        except OSError:
+            pass
+
+    def vm_hwm_bytes() -> "int | None":
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmHWM:"):
+                        return int(line.split()[1]) * 1024
+        except OSError:
+            pass
+        return None
+
+    # sampled fallback peak: ru_maxrss is fork-poisoned by a fat parent and
+    # some container kernels expose neither VmHWM nor clear_refs — sample
+    # current RSS at every chunk boundary instead (the build loop is where
+    # the transients live)
+    peak_seen = [0]
+
+    def sample_peak() -> None:
+        peak_seen[0] = max(peak_seen[0], get_used_memory())
+
+    chunk = 1 << 16
+    raw_bytes = n * features * 4
+    rng = np.random.default_rng(9)
+
+    gc.collect()
+    trim()
+    reset_peak()
+    hwm_base = vm_hwm_bytes()
+    rss_before = get_used_memory()
+
+    def chunks():
+        for a in range(0, n, chunk):
+            b = min(n, a + chunk)
+            # native-f32 generation: standard_normal would materialize a
+            # float64 intermediate twice the chunk's size and bill the
+            # store's peak for it
+            yield ([f"i{i}" for i in range(a, b)],
+                   rng.random((b - a, features), dtype=np.float32) - 0.5)
+            sample_peak()
+            trim()  # peak must reflect the store, not retained chunk buffers
+
+    model = None
+    device_bytes = 0
+    if variant == "dict":
+        store: dict = {}
+        for ids, mat in chunks():
+            for i, id_ in enumerate(ids):
+                store[id_] = mat[i].copy()
+        live_rows = len(store)
+    elif variant == "arena":
+        from oryx_tpu.models.als.vectors import FeatureVectorStore
+
+        # presized, as a MODEL handoff would be (the PMML meta names every
+        # expected row) — no doubling-growth copies in the measurement
+        store = FeatureVectorStore(initial_rows=n)
+        for ids, mat in chunks():
+            store.bulk_load(ids, mat)
+        live_rows = store.size()
+    elif variant.startswith("device-"):
+        from oryx_tpu.models.als.serving import ALSServingModel
+
+        model = ALSServingModel(
+            features, implicit=True, device_dtype=variant[len("device-"):]
+        )
+        model.y.reserve(n)
+        for ids, mat in chunks():
+            model.bulk_load_items(ids, mat)
+        _ = model.top_n_batch(
+            rng.standard_normal((8, features)).astype(np.float32), 10
+        )  # materialize the device snapshot through a real query
+        device_bytes = model.device_factor_bytes()
+        live_rows = model.y.size()
+    else:
+        raise ValueError(f"unknown store-memory variant: {variant}")
+
+    gc.collect()
+    sample_peak()
+    trim()
+    rss_after = get_used_memory()
+    # peak: kernel VmHWM where usable and not fork-poisoned (reset worked
+    # when the post-reset HWM is near rss_before), else the sampled max
+    hwm = vm_hwm_bytes()
+    if hwm is not None and hwm_base is not None and \
+            hwm_base <= rss_before + (64 << 20):
+        peak_bytes = max(hwm, peak_seen[0])
+    else:
+        peak_bytes = peak_seen[0]
+    mb = 1024 * 1024
+    out = {
+        "variant": variant,
+        "rows": live_rows,
+        "features": features,
+        "raw_mb": round(raw_bytes / mb, 1),
+        "rss_delta_mb": round((rss_after - rss_before) / mb, 1),
+        "peak_delta_mb": round(max(0, peak_bytes - rss_before) / mb, 1),
+        "rss_delta_ratio_to_raw": round((rss_after - rss_before) / raw_bytes, 2),
+        "peak_ratio_to_raw": round(max(0, peak_bytes - rss_before) / raw_bytes, 2),
+    }
+    if variant.startswith("device-"):
+        from oryx_tpu.common import profiling
+
+        out["device_factor_mb"] = round(device_bytes / mb, 1)
+        out["device_ratio_to_raw"] = round(device_bytes / raw_bytes, 2)
+        devs = profiling.memory_snapshot().get("devices", {})
+        out["hbm_in_use_mb"] = round(
+            sum(d.get("bytes_in_use", 0) for d in devs.values()) / mb, 1
+        )
+    return out
+
+
+_HOST_PROBE_TIMEOUT = 300
+_DEVICE_PROBE_TIMEOUT = 420
+
+
+def _store_section_budget(n: int) -> int:
+    """Worst-case wall budget of one _store_memory_section run: the sum of
+    its four children's timeouts (each child is independently bounded)."""
+    extra = 60 * (n // 1_000_000)
+    return 2 * (_HOST_PROBE_TIMEOUT + extra) + 2 * (_DEVICE_PROBE_TIMEOUT + extra)
+
+
+def _store_memory_section(n: int, features: int = FEATURES) -> dict:
+    """Host dict-vs-arena RSS + device f32-vs-int8 bytes at one shape, each
+    variant in its OWN subprocess so RSS deltas are uncontaminated. Keys are
+    STABLE (``trace_summary --history`` reads them round over round)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    tag = f"{n // 1_000_000}m" if n >= 1_000_000 else f"{n // 1000}k"
+    extra = 60 * (n // 1_000_000)  # probes walk the id space in Python once
+    out: dict = {"host": {}, "device": {}, "shape": f"{n}x{features}f"}
+    for variant in ("dict", "arena"):
+        r = _section_subproc(
+            [os.path.join(here, "bench.py"), "--store-memory", variant,
+             str(n), str(features)],
+            _HOST_PROBE_TIMEOUT + extra, metric=f"store_memory_{variant}",
+        )
+        out["host"][f"{variant}_{tag}_{features}f"] = r
+    for variant in ("device-float32", "device-int8"):
+        r = _section_subproc(
+            [os.path.join(here, "bench.py"), "--store-memory", variant,
+             str(n), str(features)],
+            _DEVICE_PROBE_TIMEOUT + extra, metric=f"store_memory_{variant}",
+        )
+        out["device"][f"{variant[len('device-'):]}_{tag}_{features}f"] = r
+    dict_r = out["host"].get(f"dict_{tag}_{features}f", {})
+    arena_r = out["host"].get(f"arena_{tag}_{features}f", {})
+    if dict_r.get("rss_delta_mb") and arena_r.get("rss_delta_mb"):
+        out["arena_vs_dict_rss_ratio"] = round(
+            arena_r["rss_delta_mb"] / dict_r["rss_delta_mb"], 2
+        )
+    f32_r = out["device"].get(f"float32_{tag}_{features}f", {})
+    int8_r = out["device"].get(f"int8_{tag}_{features}f", {})
+    if f32_r.get("device_factor_mb") and int8_r.get("device_factor_mb"):
+        out["int8_vs_f32_device_ratio"] = round(
+            int8_r["device_factor_mb"] / f32_r["device_factor_mb"], 2
+        )
+    return out
 
 
 def _span_breakdown() -> dict:
@@ -725,9 +933,17 @@ def main() -> None:
         print("backend probe failed; sections fall back to CPU",
               file=sys.stderr)
 
+    serving_argv = [os.path.join(here, "bench.py"), "--serving"]
+    # the serving section now contains the store-memory probes: its own
+    # timeout must cover their per-child budgets, or the parent kill fires
+    # first and erases the headline metric along with the memory section
+    serving_timeout = SERVING_SUBPROC_TIMEOUT + _store_section_budget(N_ITEMS)
+    if "--big" in sys.argv:  # forward: adds the 6M-row memory section
+        serving_argv.append("--big")
+        serving_timeout += _store_section_budget(6_000_000)
     record = _section_subproc(
-        [os.path.join(here, "bench.py"), "--serving"],
-        SERVING_SUBPROC_TIMEOUT, force_cpu=not on_tpu,
+        serving_argv,
+        serving_timeout, force_cpu=not on_tpu,
         metric="als_recommend_throughput_1M_items_50f",
     )
     if record.get("backend") == "tpu" and "error" not in record:
@@ -788,6 +1004,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--store-memory" in sys.argv:
+        try:
+            from oryx_tpu.common.executils import pin_cpu_platform_if_forced
+
+            pin_cpu_platform_if_forced()
+            i = sys.argv.index("--store-memory")
+            print(json.dumps(_store_memory_probe(
+                sys.argv[i + 1], int(sys.argv[i + 2]), int(sys.argv[i + 3])
+            )))
+        except Exception as e:  # noqa: BLE001 — always emit a JSON line
+            print(json.dumps({
+                "metric": "store_memory", "error": f"{type(e).__name__}: {e}",
+            }))
+        sys.exit(0)
     if "--transport" in sys.argv:
         try:
             print(json.dumps(_transport_bench()))

@@ -111,6 +111,21 @@ _DEV_LIMIT = metrics_mod.default_registry().gauge(
     "Usable device memory limit, per local device",
     ("device",),
 )
+_ARENA_BYTES = metrics_mod.default_registry().gauge(
+    "oryx_factor_arena_bytes",
+    "Host bytes allocated by factor-arena slabs across live vector stores "
+    "(models/als/vectors.py: one contiguous (N, k) float32 slab per store)",
+)
+_ARENA_FILL = metrics_mod.default_registry().gauge(
+    "oryx_factor_arena_fill_fraction",
+    "Live rows / allocated rows across factor arenas (doubling growth and "
+    "tombstones make this < 1; GC compaction pulls it back up)",
+)
+_QUANT_BYTES = metrics_mod.default_registry().gauge(
+    "oryx_device_quantized_factor_bytes",
+    "Device bytes held by quantized factor snapshots "
+    "(oryx.serving.device-dtype = int8: int8 slab + per-row f32 scales)",
+)
 
 #: Known per-chip peaks by device-kind prefix: (f32 matmul FLOP/s, HBM B/s).
 #: Used when ``oryx.profiling.peak-tflops``/``peak-hbm-gbps`` are 0 — the
@@ -308,6 +323,48 @@ def host_peak_rss_bytes() -> int:
 
 _HOST_RSS.set_function(_host_rss)
 _HOST_PEAK_RSS.set_function(lambda: float(host_peak_rss_bytes()))
+
+
+# -- factor-arena / quantized-snapshot telemetry ----------------------------
+# WEAK sets: a retired store or snapshot must never be pinned by its gauge
+# (the exact hazard the load-fraction gauge's weakref solves). Providers
+# expose arena_nbytes()/arena_fill() and quantized_nbytes() respectively.
+import weakref as _weakref  # noqa: E402 — stdlib, kept near its single use
+
+_ARENAS: "_weakref.WeakSet" = _weakref.WeakSet()
+_QUANT_PROVIDERS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def register_arena(store) -> None:
+    """Track a live factor arena for the scrape-time byte/fill gauges."""
+    _ARENAS.add(store)
+
+
+def register_quantized(provider) -> None:
+    """Track a live quantized device snapshot (``quantized_nbytes()``)."""
+    _QUANT_PROVIDERS.add(provider)
+
+
+def _arena_bytes() -> float:
+    return float(sum(s.arena_nbytes() for s in list(_ARENAS)))
+
+
+def _arena_fill() -> float:
+    sized = [(s.arena_nbytes(), s.arena_fill()) for s in list(_ARENAS)]
+    sized = [(b, f) for b, f in sized if b > 0]
+    if not sized:
+        return 0.0
+    total = sum(b for b, _ in sized)
+    return sum(b * f for b, f in sized) / total  # byte-weighted fill
+
+
+def _quantized_bytes() -> float:
+    return float(sum(p.quantized_nbytes() for p in list(_QUANT_PROVIDERS)))
+
+
+_ARENA_BYTES.set_function(_arena_bytes)
+_ARENA_FILL.set_function(_arena_fill)
+_QUANT_BYTES.set_function(_quantized_bytes)
 
 
 def _device_stat_fn(device, stat: str):

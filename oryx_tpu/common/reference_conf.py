@@ -159,6 +159,32 @@ oryx = {
     # sequence number — that pattern). Nothing is ever lost or skipped.
     update-resume = "earliest"
     no-init-topics = false
+    # Device representation of the serving factor matrix:
+    #   "auto"     - bfloat16 scoring copy on TPU, float32 elsewhere (the
+    #                historic behavior; exact dots/norms keep f32 either way)
+    #   "float32"  - force the f32 scan everywhere
+    #   "bfloat16" - force the bf16 scoring copy (half the f32 HBM)
+    #   "int8"     - per-row-scaled int8 factors ONLY on device (1/4 the f32
+    #                HBM: a 21M x 50f item side is ~1.1 GB instead of 4.2);
+    #                the scan returns rescore-factor x howMany candidates
+    #                whose final ranking is an exact f32 rescore from the
+    #                host factor arena (docs/admin.md "Choosing device-dtype")
+    device-dtype = "auto"
+    # int8 path: candidates scanned per request = rescore-factor x howMany
+    # (pow2-rounded, floor 16). Higher = better recall under heavy
+    # quantization error, more rescore work; 4 holds recall@10 >= 0.99.
+    rescore-factor = 4
+    # Host factor-arena sizing (models/als/vectors.py): one contiguous
+    # (rows, features) float32 slab per store, grown by doubling.
+    arena = {
+      # Rows a fresh slab starts with (point-update-built stores; bulk
+      # handoffs size to the model exactly).
+      initial-rows = 1024
+      # Compact the slab after GC when live rows fall below this fraction
+      # of capacity (a retained 1%-survivor model must not pin the old
+      # generation's full arena). 0 disables compaction.
+      min-fill = 0.25
+    }
     # Shard the item-factor matrix over all local devices so Y can exceed
     # one chip's memory; top-N becomes per-shard top-k + cross-shard merge.
     compute = {

@@ -68,6 +68,13 @@ class AbstractLayer:
         # /metrics surface as serving replicas (scraped or snapshotted by
         # bench_batch) — peaks and gauges configure here too
         profiling.configure(config)
+        # factor-arena sizing: the speed tier's model stores are arena
+        # users exactly like serving's, and must honor the same
+        # oryx.serving.arena.* knobs (the module is pure numpy — no jax
+        # import rides in with it)
+        from oryx_tpu.models.als import vectors as als_vectors
+
+        als_vectors.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")

@@ -95,13 +95,24 @@ def _round_up_pow2(n: int) -> int:
 _EXCL_PAD_MIN = 8
 
 
-def _topn_cost_key(batch_size: int, excl: bool) -> str:
+#: Valid values of ``oryx.serving.device-dtype``: "auto" keeps the historic
+#: behavior (bf16 scoring copy on TPU, f32 elsewhere); explicit f32/bf16
+#: force the scoring dtype; "int8" holds ONLY a per-row-scaled int8 slab on
+#: device (¼ the f32 HBM) and rescores the top candidates exactly in f32
+#: from the host factor arena before the final top-k.
+_DEVICE_DTYPES = ("auto", "float32", "bfloat16", "int8")
+
+
+def _topn_cost_key(batch_size: int, excl: bool, quant: bool = False) -> str:
     """Cost-accounting program signature for one batched top-N variant.
-    Keyed by (batch size, exclusion-carrying) — the axes the coalescer's
-    pow2 padding and the warm ladder actually produce; top-k width drift
-    (unusual howMany) folds into the same key, a documented approximation
-    (docs/observability.md "Device performance attribution")."""
-    return f"als.top_n_batch/b{batch_size}" + ("+excl" if excl else "")
+    Keyed by (batch size, exclusion-carrying, quantized) — the axes the
+    coalescer's pow2 padding and the warm ladder actually produce; top-k
+    width drift (unusual howMany) folds into the same key, a documented
+    approximation (docs/observability.md "Device performance attribution").
+    Quantized programs get their OWN keys: their per-call cost (int8 reads,
+    rescale multiply) differs from the f32/bf16 scan's."""
+    return (f"als.top_n_batch/b{batch_size}"
+            + ("+excl" if excl else "") + ("+int8" if quant else ""))
 
 
 def _score(qs, mat):
@@ -228,6 +239,74 @@ def _top_k_cosine_sum(mat, norms, qs, q_norms, valid, k: int):
     return jax.lax.top_k(scores, k)
 
 
+# -- quantized (int8) candidate scan ----------------------------------------
+# The int8 device path reads ¼ the HBM of f32 per scan (the scan is
+# bandwidth-bound: one pass over Y per query batch), at the cost of ~0.4%
+# relative rounding error per score. The approximate scores only CHOOSE
+# candidates; the final ranking comes from an exact f32 rescore of the top
+# ``rescore-factor × how_many`` rows gathered from the host factor arena —
+# so recall, not precision, is the only quantization exposure.
+
+
+def _quantize_rows(mat: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """Per-row symmetric int8 quantization: scale_i = max|row_i| / 127.
+    Zero rows get scale 1 (their dots are exactly 0 either way)."""
+    if mat.size == 0:
+        return (np.zeros(mat.shape, dtype=np.int8),
+                np.ones(mat.shape[0], dtype=np.float32))
+    amax = np.max(np.abs(mat), axis=1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(mat / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+@jax.jit
+def _quant_masked_scores(qmat, qscale, qs, valid, excl):
+    """(B, n) approximate scores off the int8 slab: the convert rides the
+    matmul operand (XLA fuses it — HBM traffic stays int8), accumulation is
+    f32, and the per-row scale lands as one broadcast multiply."""
+    scores = jnp.matmul(
+        qs, qmat.T.astype(qs.dtype), preferred_element_type=jnp.float32
+    ) * qscale[None, :]
+    if valid is not None:
+        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    if excl is not None:
+        scores = _mask_excluded(scores, excl)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _quant_candidates(qmat, qscale, qs, valid, excl, k: int):
+    """Top-k CANDIDATES (approximate scores) for the exact f32 rescore."""
+    return _top_k_of_scores(_quant_masked_scores(qmat, qscale, qs, valid, excl), k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _quant_candidates_masked(qmat, qscale, qs, lut, buckets, excl, k: int):
+    """Per-query-LUT (LSH) variant of the quantized candidate scan."""
+    scores = jnp.matmul(
+        qs, qmat.T.astype(qs.dtype), preferred_element_type=jnp.float32
+    ) * qscale[None, :]
+    valid = jnp.take_along_axis(lut, buckets[None, :], axis=1)
+    scores = jnp.where(valid, scores, -jnp.inf)
+    if excl is not None:
+        scores = _mask_excluded(scores, excl)
+    return jax.lax.approx_max_k(scores, k, recall_target=0.99)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _quant_cosine_candidates(qmat, qscale, norms, qs, q_norms, valid, k: int):
+    """Mean-cosine candidates off the int8 slab (norms are EXACT f32,
+    computed host-side from the arena at snapshot time)."""
+    sims = (jnp.matmul(
+        qs, qmat.T.astype(qs.dtype), preferred_element_type=jnp.float32
+    ) * qscale[None, :]) / jnp.maximum(
+        norms[None, :] * q_norms[:, None], 1e-12
+    )
+    scores = jnp.where(valid, jnp.mean(sims, axis=0), -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
 class _YSnapshot:
     """Immutable device view of Y: ids, matrix, norms, LSH buckets. With a
     mesh, the scoring copy is row-sharded over ``shard_axis`` (rows padded to
@@ -250,8 +329,10 @@ class _YSnapshot:
         shard_axis: str = "model",
         prev: "_YSnapshot | None" = None,
         delta: "tuple[np.ndarray, int] | None" = None,
+        device_dtype: str = "auto",
     ):
         self.ids = ids
+        self.device_dtype = device_dtype
         self.mat = mat  # jax (n, k) or None, float32
         # lazy cost-registration marks (see _top_n_batch): per GENERATION so
         # a model swap re-registers against the new shapes, but carried
@@ -282,10 +363,18 @@ class _YSnapshot:
         if mat is not None:
             self.norms = jnp.linalg.norm(mat, axis=1)
             # scoring copy: bf16 on TPU halves HBM traffic per scan; exact
-            # dots/norms keep the f32 matrix
-            self.score_mat = (
-                mat.astype(jnp.bfloat16) if jax.default_backend() == "tpu" else mat
-            )
+            # dots/norms keep the f32 matrix. An explicit
+            # oryx.serving.device-dtype overrides the backend heuristic
+            # (int8 never reaches this class — see _QuantSnapshot)
+            if device_dtype == "float32":
+                self.score_mat = mat
+            elif device_dtype == "bfloat16":
+                self.score_mat = mat.astype(jnp.bfloat16)
+            else:  # auto
+                self.score_mat = (
+                    mat.astype(jnp.bfloat16)
+                    if jax.default_backend() == "tpu" else mat
+                )
             if lsh and lsh.num_hashes:
                 if prev is not None and delta is not None and prev.buckets is not None:
                     # rehash only the delta: pull changed/new rows (not the
@@ -346,6 +435,154 @@ class _YSnapshot:
         return len(self.ids)
 
 
+#: Host-side quantization chunk: bounds the transient f32 gather while
+#: building a full quantized snapshot (2^16 rows × 50f ≈ 13 MB per chunk
+#: instead of one n×k f32 copy next to the arena slab).
+_QUANT_CHUNK = 1 << 16
+
+
+class _QuantSnapshot:
+    """Immutable int8 device view of Y (``oryx.serving.device-dtype = int8``):
+    per-row-scaled int8 factors + exact f32 norms + optional LSH buckets.
+    No f32 (or bf16) copy of Y ever lands in HBM — the whole point of the
+    mode is fitting a 21M × 50f item side per chip with headroom.
+
+    Built from the factor arena's HOST snapshot (``host_matrix``) and kept
+    current with composed host deltas (``delta_info``): a speed microbatch
+    of point updates requantizes only the changed/appended rows and lands
+    them as row-index scatters, mirroring the f32 path's incremental
+    device maintenance. ``version`` anchors the next delta."""
+
+    def __init__(self, ids, version: int, qmat, qscale, norms, buckets,
+                 prev: "_QuantSnapshot | None" = None,
+                 appended: "list[str] | None" = None,
+                 slab=None, slab_rows=None):
+        self.ids = ids
+        self.version = version
+        self.qmat = qmat        # (n, k) int8 device
+        self.qscale = qscale    # (n,) f32 device
+        self.norms = norms      # (n,) f32 device, exact
+        self.buckets = buckets  # (n,) int32 device or None
+        # pinned exact-rescore view: THIS snapshot's slab object + its row
+        # indices, captured by the store in the same order epoch as `ids`.
+        # Structural store changes (GC, compaction) replace the live
+        # slab/rowmap and never disturb this pair, so a rescore can never
+        # crash on, or misalign against, a concurrently mutated store. A
+        # point update rewriting a captured row in place is visible here —
+        # the rescore ranks with fresher factors than the scan, benign.
+        self.slab = slab
+        self.slab_rows = slab_rows  # (n,) slab row per snapshot position
+        self.mat = None         # no f32 device matrix in this mode
+        self.score_mat = None
+        self.sharded_mat = None
+        self.sharded_buckets = None
+        self.mesh = None
+        if prev is not None and appended is not None:
+            # id→idx append-only sharing, exactly like _YSnapshot
+            self.id_to_idx = prev.id_to_idx
+            for i in range(len(prev.ids), len(ids)):
+                self.id_to_idx[ids[i]] = i
+        else:
+            self.id_to_idx = {s: i for i, s in enumerate(ids)}
+        # lazy cost-registration marks: per generation, carried across
+        # same-shape incremental snapshots (see _YSnapshot)
+        if (prev is not None
+                and getattr(prev.qmat, "shape", None)
+                == getattr(qmat, "shape", None)):
+            self.cost_keys_attempted = prev.cost_keys_attempted
+        else:
+            self.cost_keys_attempted: set = set()
+        profiling.register_quantized(self)
+
+    @property
+    def n(self) -> int:
+        return len(self.ids)
+
+    def quantized_nbytes(self) -> int:
+        """Device bytes held by the quantized factors (the
+        oryx_device_quantized_factor_bytes gauge)."""
+        total = 0
+        for arr in (self.qmat, self.qscale):
+            total += int(getattr(arr, "nbytes", 0) or 0)
+        return total
+
+    def gather_rows(self, positions: np.ndarray) -> np.ndarray:
+        """Exact f32 factor rows for snapshot ``positions``, gathered from
+        the PINNED slab view (see __init__) — one fancy index."""
+        pos = np.clip(np.asarray(positions, dtype=np.int64), 0, self.n - 1)
+        return self.slab[self.slab_rows[pos]]
+
+    @classmethod
+    def build(cls, ids, host: np.ndarray, version: int,
+              lsh: "LocalitySensitiveHash | None",
+              row_view: tuple,
+              prev: "_QuantSnapshot | None" = None):
+        """Full quantized build from one host matrix, chunked so the
+        transient stays bounded at reference scale."""
+        n = len(ids)
+        slab, slab_rows = row_view
+        if n == 0 or host.size == 0:
+            return cls(list(ids), version, None, None, None, None)
+        k = host.shape[1]
+        q = np.empty((n, k), dtype=np.int8)
+        scale = np.empty(n, dtype=np.float32)
+        norms = np.empty(n, dtype=np.float32)
+        for a in range(0, n, _QUANT_CHUNK):
+            b = min(n, a + _QUANT_CHUNK)
+            q[a:b], scale[a:b] = _quantize_rows(host[a:b])
+            norms[a:b] = np.linalg.norm(host[a:b], axis=1)
+        buckets = None
+        if lsh and lsh.num_hashes:
+            buckets = jnp.asarray(lsh.assign_buckets(host))
+        return cls(list(ids), version, jnp.asarray(q), jnp.asarray(scale),
+                   jnp.asarray(norms), buckets, prev=prev,
+                   slab=slab, slab_rows=slab_rows)
+
+    @classmethod
+    def from_delta(cls, prev: "_QuantSnapshot", delta,
+                   lsh: "LocalitySensitiveHash | None"):
+        """Incremental step: requantize only the changed/appended rows and
+        land them as device row scatters / one append."""
+        qmat, qscale, norms, buckets = (
+            prev.qmat, prev.qscale, prev.norms, prev.buckets
+        )
+        changed_pos = [prev.id_to_idx[i] for i in delta.changed_ids
+                       if i in prev.id_to_idx]
+        if changed_pos:
+            pos = jnp.asarray(changed_pos, dtype=jnp.int32)
+            qc, sc = _quantize_rows(delta.changed_vals)
+            qmat = qmat.at[pos].set(jnp.asarray(qc))
+            qscale = qscale.at[pos].set(jnp.asarray(sc))
+            norms = norms.at[pos].set(
+                jnp.asarray(np.linalg.norm(delta.changed_vals, axis=1))
+            )
+            if buckets is not None:
+                buckets = buckets.at[pos].set(
+                    jnp.asarray(lsh.assign_buckets(delta.changed_vals))
+                )
+        if delta.appended_ids:
+            qa, sa = _quantize_rows(delta.appended_vals)
+            qmat = jnp.concatenate([qmat, jnp.asarray(qa)])
+            qscale = jnp.concatenate([qscale, jnp.asarray(sa)])
+            norms = jnp.concatenate([norms, jnp.asarray(
+                np.linalg.norm(delta.appended_vals, axis=1))])
+            if buckets is not None:
+                buckets = jnp.concatenate([buckets, jnp.asarray(
+                    lsh.assign_buckets(delta.appended_vals))])
+        ids = prev.ids + delta.appended_ids
+        # extend the pinned rescore view: delta.slab is the CURRENT slab
+        # (a non-structural grow copies rows in place, so prev's indices
+        # stay valid in it) and the appended ids bring their own rows
+        slab_rows = (
+            np.concatenate([prev.slab_rows,
+                            np.asarray(delta.appended_rows, dtype=np.int64)])
+            if len(delta.appended_ids) else prev.slab_rows
+        )
+        return cls(ids, delta.version, qmat, qscale, norms, buckets,
+                   prev=prev, appended=delta.appended_ids,
+                   slab=delta.slab, slab_rows=slab_rows)
+
+
 class ALSServingModel(ServingModel):
     def __init__(
         self,
@@ -354,10 +591,27 @@ class ALSServingModel(ServingModel):
         sample_rate: float = 1.0,
         mesh=None,
         shard_axis: str = "model",
+        device_dtype: str = "auto",
+        rescore_factor: float = 4.0,
     ):
         self.features = features
         self.implicit = implicit
         self.sample_rate = sample_rate
+        if device_dtype not in _DEVICE_DTYPES:
+            raise ValueError(
+                f"oryx.serving.device-dtype must be one of {_DEVICE_DTYPES}, "
+                f"not {device_dtype!r}"
+            )
+        if device_dtype == "int8" and mesh is not None:
+            # the sharded scan's shard_map programs are f32/bf16; quantized
+            # sharding is a later round — degrade loudly, never silently
+            log.warning(
+                "device-dtype=int8 is not supported with sharded serving; "
+                "using bfloat16 for the sharded scoring copy"
+            )
+            device_dtype = "bfloat16"
+        self.device_dtype = device_dtype
+        self.rescore_factor = max(1.0, float(rescore_factor))
         self.mesh = mesh
         self.shard_axis = shard_axis
         self.x = FeatureVectorStore()
@@ -458,7 +712,9 @@ class ALSServingModel(ServingModel):
         return (self.x.size() + self.y.size()) / total
 
     # -- device snapshot ----------------------------------------------------
-    def y_snapshot(self) -> _YSnapshot:
+    def y_snapshot(self):
+        if self.device_dtype == "int8":
+            return self._quant_snapshot()
         ids, mat = self.y.materialize()
         with self._snap_lock:
             if self._snapshot is None or self._snapshot_src is not mat:
@@ -472,10 +728,88 @@ class ALSServingModel(ServingModel):
                         prev = self._snapshot
                 self._snapshot = _YSnapshot(
                     ids, mat, self.lsh, self.mesh, self.shard_axis,
-                    prev=prev, delta=delta,
+                    prev=prev, delta=delta, device_dtype=self.device_dtype,
                 )
                 self._snapshot_src = mat
             return self._snapshot
+
+    def _quant_snapshot(self) -> _QuantSnapshot:
+        """Current int8 device view: incremental (requantize + scatter only
+        the rows a speed microbatch touched) when the arena's write log
+        covers the gap, full chunked rebuild otherwise. The store's f32
+        device-materialization cache is never engaged in this mode — the
+        arena slab itself is the exact-f32 source of truth (the rescore
+        gathers straight from it)."""
+        with self._snap_lock:
+            prev = self._snapshot if isinstance(self._snapshot, _QuantSnapshot) else None
+            if prev is not None and prev.qmat is not None:
+                delta = self.y.delta_info(prev.version, len(prev.ids))
+                if delta is not None:
+                    if not delta.changed_ids and not delta.appended_ids:
+                        return prev
+                    self._snapshot = _QuantSnapshot.from_delta(
+                        prev, delta, self.lsh
+                    )
+                    return self._snapshot
+            ids, host, version, row_view = self.y.host_matrix()
+            self._snapshot = _QuantSnapshot.build(
+                ids, host, version, self.lsh, row_view, prev=prev
+            )
+            return self._snapshot
+
+    def _rescore_exact(self, snap: _QuantSnapshot, qs_host: np.ndarray,
+                       vals: np.ndarray, idx: np.ndarray,
+                       cosine: bool = False) -> "tuple[np.ndarray, np.ndarray]":
+        """Exact f32 rescore of the quantized scan's candidates: gather the
+        candidate rows from the snapshot's PINNED arena-slab view (one
+        fancy index — the slab is what makes this cheap), recompute exact
+        scores, and return the candidates re-ranked by exact score. Masked
+        candidates (-inf from the scan) stay -inf. For ``cosine`` the batch
+        dimension is the query-vector set of ONE request (mean cosine)."""
+        B, R = idx.shape
+        rows = snap.gather_rows(idx.reshape(-1)).reshape(B, R, -1)
+        if cosine:
+            # one request, many query vectors: qs_host (Q, k); rows (1, R, k)
+            r = rows[0]
+            rn = np.linalg.norm(r, axis=1)
+            qn = np.linalg.norm(qs_host, axis=1)
+            sims = (r @ qs_host.T) / np.maximum(
+                rn[:, None] * qn[None, :], 1e-12
+            )
+            exact = np.mean(sims, axis=1, dtype=np.float32)[None, :]
+        else:
+            exact = np.einsum("bk,brk->br", qs_host, rows).astype(np.float32)
+        exact = np.where(np.isfinite(vals), exact, -np.inf)
+        order = np.argsort(-exact, axis=1, kind="stable")
+        return (np.take_along_axis(exact, order, axis=1),
+                np.take_along_axis(idx, order, axis=1))
+
+    def _quant_scan(self, snap: _QuantSnapshot, qs_host: np.ndarray,
+                    r: int, excl, valid=None, lut=None,
+                    register_cost: "str | None" = None):
+        """One quantized candidate scan + exact rescore: (vals, idx) of
+        width ``r``, exact-f32-ranked. ``excl`` is the padded (B, E) index
+        array or None; ``valid`` an optional (n,) candidate mask; ``lut``
+        a per-query (B, num_buckets) LSH lookup table (selects the masked
+        program). One registration/record/rescore sequence serves every
+        variant."""
+        qs = jnp.asarray(qs_host)
+        if lut is not None:
+            fn = _quant_candidates_masked
+            args = (snap.qmat, snap.qscale, qs, lut, snap.buckets, excl, r)
+        else:
+            fn = _quant_candidates
+            args = (snap.qmat, snap.qscale, qs, valid, excl, r)
+        if register_cost is not None and (
+                register_cost not in snap.cost_keys_attempted
+                and metrics_mod.default_registry().enabled):
+            snap.cost_keys_attempted.add(register_cost)
+            compilecache.aot_compile(fn, *args, cost_key=register_cost)
+        vals, idx = fn(*args)
+        if register_cost is not None:
+            profiling.costs().record(register_cost)
+        return self._rescore_exact(snap, qs_host, np.asarray(vals),
+                                   np.asarray(idx))
 
     # -- query primitives ----------------------------------------------------
     @staticmethod
@@ -548,9 +882,13 @@ class ALSServingModel(ServingModel):
         are masked on device; ``allowed``/``rescore`` host hooks (rescorer SPI)
         filter the candidate stream with widening retry."""
         snap = self.y_snapshot()
-        if snap.mat is None or snap.n == 0:
+        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
             return []
         q_host = np.asarray(query_vec, dtype=np.float32)
+        if isinstance(snap, _QuantSnapshot):
+            return self._quant_top_n(
+                snap, q_host, how_many, offset, allowed, rescore, excluded
+            )
         want = how_many + offset
         if snap.sharded_mat is not None:
             k = want if allowed is None and rescore is None else max(4 * want, 64)
@@ -587,6 +925,38 @@ class ALSServingModel(ServingModel):
                 return out[offset:offset + how_many]
             k = min(snap.n, k * 2)  # widen if filtering consumed candidates
 
+    def _quant_top_n(
+        self, snap: _QuantSnapshot, q_host: np.ndarray, how_many: int,
+        offset: int, allowed, rescore, excluded,
+    ) -> list[tuple[str, float]]:
+        """Single-query top-N on the int8 path: quantized candidate scan →
+        exact f32 rescore from the arena → host filtering. The quantized
+        matmul runs ONCE; widenings (``allowed``/``rescore`` hooks consuming
+        candidates) re-run only the top-k over the cached score matrix,
+        exactly like the f32 path — never another full-bandwidth pass
+        over the int8 slab."""
+        want = how_many + offset
+        excl = None
+        if excluded:
+            padded = self._excluded_indices(snap, [excluded], 1)
+            if (padded >= 0).any():
+                excl = jnp.asarray(padded)
+        has_lsh = self.lsh is not None and snap.buckets is not None
+        valid = self._candidate_mask(snap, q_host) if has_lsh else None
+        scores = _quant_masked_scores(
+            snap.qmat, snap.qscale, jnp.asarray(q_host[None, :]), valid, excl
+        )
+        r = min(snap.n, _round_up_pow2(max(int(self.rescore_factor * want), 16)))
+        while True:
+            v, i = _top_k_of_scores(scores, r)
+            vals, idx = self._rescore_exact(
+                snap, q_host[None, :], np.asarray(v), np.asarray(i)
+            )
+            out = self._collect(snap, vals[0], idx[0], want, allowed, rescore)
+            if len(out) >= want or r >= snap.n:
+                return out[offset:offset + how_many]
+            r = min(snap.n, r * 2)  # widen: host filter consumed candidates
+
     def top_n_batch(
         self,
         query_vecs: np.ndarray,
@@ -619,10 +989,14 @@ class ALSServingModel(ServingModel):
         excluded: "Sequence[Sequence[str] | None] | None" = None,
     ) -> list[list[tuple[str, float]]]:
         snap = self.y_snapshot()
-        if snap.mat is None or snap.n == 0:
+        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
             return [[] for _ in range(len(query_vecs))]
         qs_host = np.asarray(query_vecs, dtype=np.float32)
         filtering = alloweds is not None and any(a is not None for a in alloweds)
+        if isinstance(snap, _QuantSnapshot):
+            return self._quant_top_n_batch(
+                snap, qs_host, how_many, alloweds, excluded, filtering
+            )
         if snap.sharded_mat is not None and not filtering:
             # sharded scan: calls are attributed (cost accounting counts
             # them) but no per-call cost is registered for the multi-shard
@@ -704,6 +1078,55 @@ class ALSServingModel(ServingModel):
             out.append(got)
         return out
 
+    def _quant_top_n_batch(
+        self, snap: _QuantSnapshot, qs_host: np.ndarray, how_many: int,
+        alloweds, excluded, filtering: bool,
+    ) -> list[list[tuple[str, float]]]:
+        """Batched top-N on the int8 path: ONE quantized device scan over
+        the whole query batch (¼ the f32 HBM per pass) returning
+        ``rescore-factor × how_many`` candidates each, exact-f32-rescored
+        from the arena slab before the final cut. Cost keys carry ``+int8``
+        so the attribution (and the warm ladder) see the quantized programs
+        as their own signatures."""
+        use_excl = excluded is not None and any(e for e in excluded)
+        excl = (
+            jnp.asarray(self._excluded_indices(snap, excluded, len(qs_host)))
+            if use_excl
+            else None
+        )
+        cost_key = _topn_cost_key(len(qs_host), use_excl, quant=True)
+        r = min(snap.n,
+                _round_up_pow2(max(int(self.rescore_factor * how_many), 16)))
+        lut = (
+            jnp.asarray(self._build_lut(qs_host))
+            if self.lsh is not None and snap.buckets is not None
+            else None
+        )
+        vals, idx = self._quant_scan(
+            snap, qs_host, r, excl, lut=lut, register_cost=cost_key
+        )
+        if not filtering:
+            ids = snap.ids
+            vb, ib = vals[:, :how_many], idx[:, :how_many]
+            return [
+                [(ids[int(i_)], float(v_)) for v_, i_ in zip(vb[b], ib[b])
+                 if np.isfinite(v_)]
+                for b in range(len(qs_host))
+            ]
+        out = []
+        for b in range(len(qs_host)):
+            allowed = alloweds[b] if alloweds else None
+            got = self._collect(snap, vals[b], idx[b], how_many, allowed, None)[:how_many]
+            if len(got) < how_many and r < snap.n:
+                # heavy filtering consumed this query's candidates — fall
+                # back to the widening single-query quant path
+                got = self._quant_top_n(
+                    snap, qs_host[b], how_many, 0, allowed, None,
+                    excluded[b] if excluded else None,
+                )
+            out.append(got)
+        return out
+
     def warm_bucket(self, batch_size: int, how_many: int = 10) -> None:
         """Pre-compile the batched top-N program for ONE pow2 batch size
         against the live factor shapes — the per-bucket unit of the serving
@@ -727,7 +1150,7 @@ class ALSServingModel(ServingModel):
         import jax
 
         snap = self.y_snapshot()
-        if snap.mat is None or snap.n == 0:
+        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
             raise ValueError("no item factors to warm against yet")
         qs_struct = jax.ShapeDtypeStruct(
             (batch_size, self.features), jnp.float32
@@ -735,7 +1158,39 @@ class ALSServingModel(ServingModel):
         excl_struct = jax.ShapeDtypeStruct(
             (batch_size, _EXCL_PAD_MIN), jnp.int32
         )
-        if snap.sharded_mat is not None:
+        if isinstance(snap, _QuantSnapshot):
+            # the quantized ladder: its programs (and so its AOT cost keys)
+            # are distinct from the f32/bf16 scan's — a quantized-model
+            # handoff warms exactly the signatures its traffic dispatches
+            r = min(snap.n,
+                    _round_up_pow2(max(int(self.rescore_factor * how_many), 16)))
+            keys = (_topn_cost_key(batch_size, False, quant=True),
+                    _topn_cost_key(batch_size, True, quant=True))
+            if self.lsh is None or snap.buckets is None:
+                compilecache.aot_compile(
+                    _quant_candidates, snap.qmat, snap.qscale, qs_struct,
+                    None, None, r, cost_key=keys[0],
+                )
+                compilecache.aot_compile(
+                    _quant_candidates, snap.qmat, snap.qscale, qs_struct,
+                    None, excl_struct, r, cost_key=keys[1],
+                )
+            else:
+                lut_struct = jax.ShapeDtypeStruct(
+                    (batch_size, self.lsh.num_buckets), jnp.bool_
+                )
+                compilecache.aot_compile(
+                    _quant_candidates_masked, snap.qmat, snap.qscale,
+                    qs_struct, lut_struct, snap.buckets, None, r,
+                    cost_key=keys[0],
+                )
+                compilecache.aot_compile(
+                    _quant_candidates_masked, snap.qmat, snap.qscale,
+                    qs_struct, lut_struct, snap.buckets, excl_struct, r,
+                    cost_key=keys[1],
+                )
+            snap.cost_keys_attempted.update(keys)
+        elif snap.sharded_mat is not None:
             # the sharded scan builds its program through the lru-cached
             # _sharded_top_k_fn; the executions below compile it off-path
             pass
@@ -765,7 +1220,7 @@ class ALSServingModel(ServingModel):
                 lut_struct, snap.buckets, excl_struct, k,
                 cost_key=_topn_cost_key(batch_size, True),
             )
-        if snap.sharded_mat is None:
+        if snap.sharded_mat is None and not isinstance(snap, _QuantSnapshot):
             # mark both signatures attempted: the lazy first-use
             # registration in _top_n_batch would otherwise re-lower and
             # re-compile each one the ladder just registered — once per
@@ -794,7 +1249,7 @@ class ALSServingModel(ServingModel):
     ) -> list[tuple[str, float]]:
         """Mean-cosine top-N for /similarity (CosineAverageFunction.java:67)."""
         snap = self.y_snapshot()
-        if snap.mat is None or snap.n == 0:
+        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
             return []
         qs_host = np.atleast_2d(np.asarray(query_vecs, dtype=np.float32))
         qs = jnp.asarray(qs_host)
@@ -805,6 +1260,23 @@ class ALSServingModel(ServingModel):
         for extra in qs_host[1:]:
             valid = valid | self._candidate_mask(snap, extra)
         want = how_many + offset
+        if isinstance(snap, _QuantSnapshot):
+            # quantized candidates (norms are exact f32), exact mean-cosine
+            # rescore from the arena slab before the final cut
+            r = min(snap.n,
+                    _round_up_pow2(max(int(self.rescore_factor * want), 16)))
+            while True:
+                v, i = _quant_cosine_candidates(
+                    snap.qmat, snap.qscale, snap.norms, qs, q_norms, valid, r
+                )
+                vals, idx = self._rescore_exact(
+                    snap, qs_host, np.asarray(v)[None, :],
+                    np.asarray(i)[None, :], cosine=True,
+                )
+                out = self._collect(snap, vals[0], idx[0], want, allowed, rescore)
+                if len(out) >= want or r >= snap.n:
+                    return out[offset:offset + how_many]
+                r = min(snap.n, r * 2)
         k = min(snap.n, _round_up_pow2(max(4 * want, 64)))
         while True:
             vals, idx = _top_k_cosine_sum(snap.mat, snap.norms, qs, q_norms, valid, k)
@@ -839,6 +1311,23 @@ class ALSServingModel(ServingModel):
         if rescore is not None:
             out.sort(key=lambda t: -t[1])
         return out
+
+    def device_factor_bytes(self) -> int:
+        """Bytes the current Y snapshot holds on device (f32 matrix +
+        scoring copy + norms + buckets, or the int8 slab + scales) — the
+        HBM side of the bench memory section's f32-vs-int8 comparison."""
+        snap = self.y_snapshot()
+        arrays = (
+            (snap.qmat, snap.qscale, snap.norms, snap.buckets)
+            if isinstance(snap, _QuantSnapshot)
+            else (snap.mat,
+                  snap.score_mat if snap.score_mat is not snap.mat else None,
+                  snap.norms, snap.buckets, snap.sharded_mat,
+                  snap.sharded_buckets)
+        )
+        return int(sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in arrays if a is not None
+        ))
 
     def dot_with_items(self, query_vec: np.ndarray, item_ids: Sequence[str]) -> list[float]:
         q = np.asarray(query_vec, dtype=np.float32)
@@ -877,6 +1366,20 @@ class ALSServingModelManager(AbstractServingModelManager):
         super().__init__(config)
         self.sample_rate = config.get_float("oryx.als.sample-rate")
         self.min_model_load_fraction = config.get_float("oryx.serving.min-model-load-fraction")
+        # device-factor representation: "auto" (bf16 scoring copy on TPU),
+        # explicit "float32"/"bfloat16", or "int8" (per-row-scaled slab +
+        # exact f32 rescore of the top rescore-factor x n candidates)
+        self.device_dtype = config.get_string(
+            "oryx.serving.device-dtype", "auto"
+        )
+        if self.device_dtype not in _DEVICE_DTYPES:
+            raise ValueError(
+                f"oryx.serving.device-dtype must be one of {_DEVICE_DTYPES}, "
+                f"not {self.device_dtype!r}"
+            )
+        self.rescore_factor = config.get_float(
+            "oryx.serving.rescore-factor", 4.0
+        )
         # opportunistic YᵀY pre-trigger once the model is loaded enough, so
         # the first fold-in request doesn't stall on the factorization
         # (ALSServingModelManager.java:95-105); rate-limited like the
@@ -980,8 +1483,14 @@ class ALSServingModelManager(AbstractServingModelManager):
             current = self._current_generation()
             if current is None or current.features != features:
                 new_model = ALSServingModel(
-                    features, meta["implicit"], self.sample_rate, mesh=self.mesh
+                    features, meta["implicit"], self.sample_rate,
+                    mesh=self.mesh, device_dtype=self.device_dtype,
+                    rescore_factor=self.rescore_factor,
                 )
+                # the handoff meta names every expected row: presize the
+                # arenas so the fill skips doubling-growth copies
+                new_model.x.reserve(len(meta["x_ids"]))
+                new_model.y.reserve(len(meta["y_ids"]))
                 new_model.expected_user_ids = set(meta["x_ids"])
                 new_model.expected_item_ids = set(meta["y_ids"])
                 with self._swap_lock:

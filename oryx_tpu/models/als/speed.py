@@ -127,6 +127,10 @@ class ALSSpeedModelManager(AbstractSpeedModelManager):
             if self.model is None or self.model.features != features:
                 log.info("new model (features=%d)", features)
                 self.model = ALSSpeedModel(features, meta["implicit"])
+                # presize the factor arenas: the handoff meta names every
+                # expected row, so the fill skips doubling-growth copies
+                self.model.x.reserve(len(meta["x_ids"]))
+                self.model.y.reserve(len(meta["y_ids"]))
                 self.model.expected_user_ids = set(meta["x_ids"])
                 self.model.expected_item_ids = set(meta["y_ids"])
             else:

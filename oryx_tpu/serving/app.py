@@ -324,6 +324,11 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     resilience.configure(config)
     faults.configure(config)
     netbroker.configure(config)  # tcp:// client timeouts/frame caps
+    # factor-arena sizing (oryx.serving.arena.*): new vector stores built by
+    # model handoffs in this process pick the slab seed/compaction knobs up
+    from oryx_tpu.models.als import vectors as als_vectors
+
+    als_vectors.configure(config)
     # roofline peaks + device-memory gauges + the profiler session config
     # (after the others: jax is imported by now, so peak auto-detection and
     # per-device gauge wiring can see the live backend)

@@ -9,6 +9,7 @@ from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
 from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
 from oryx_tpu.tools.analyze.checkers.logstyle import LogDisciplineChecker
 from oryx_tpu.tools.analyze.checkers.swallowed import SwallowedExceptionChecker
+from oryx_tpu.tools.analyze.checkers.perrowstore import PerRowNdarrayStoreChecker
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -20,4 +21,5 @@ ALL_CHECKERS = (
     Float64PromotionChecker(),
     LogDisciplineChecker(),
     SwallowedExceptionChecker(),
+    PerRowNdarrayStoreChecker(),
 )

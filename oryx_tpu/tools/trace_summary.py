@@ -584,6 +584,17 @@ def _history_row(label: str, rec: dict) -> dict:
     if peak_mb is None:
         # pre-PR-7 records carried an ad-hoc peak_rss_mb at one of two spots
         peak_mb = rec.get("peak_rss_mb", batch.get("peak_rss_mb"))
+    # round-9 memory section: the arena's host ratio-to-raw and the int8
+    # device ratio, pulled from the stable memory.stores keys
+    stores = memory.get("stores") or {}
+    arena_ratio = next(
+        (_num(v.get("rss_delta_ratio_to_raw"))
+         for k, v in (stores.get("host") or {}).items()
+         if k.startswith("arena") and isinstance(v, dict)), None)
+    int8_ratio = next(
+        (_num(v.get("device_ratio_to_raw"))
+         for k, v in (stores.get("device") or {}).items()
+         if k.startswith("int8") and isinstance(v, dict)), None)
     return {
         "round": label,
         "backend": rec.get("backend", "?"),
@@ -594,6 +605,8 @@ def _history_row(label: str, rec: dict) -> dict:
         "pack_s": _num(batch.get("pack_s")),
         "elapsed_s": _num(batch.get("elapsed_s")),
         "peak_rss_mb": _num(peak_mb),
+        "arena_ratio": arena_ratio,
+        "int8_ratio": int8_ratio,
     }
 
 
@@ -614,7 +627,7 @@ def render_history(records: list, regress_pct: float = 25.0,
 
     w(f"{'round':>6s} {'backend':>8s} {'qps':>10s} {'http_qps':>9s} "
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
-      f"{'peak_rss':>9s}\n")
+      f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -627,7 +640,9 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['p99_ms'], '{:9.1f}', 9)} {cell(r['mfu'], '{:8.4f}', 8)} "
           f"{cell(r['pack_s'], '{:8.2f}', 8)} "
           f"{cell(r['elapsed_s'], '{:9.2f}', 9)}{overlap}"
-          f"{cell(r['peak_rss_mb'], '{:7.0f}MB', 9)}\n")
+          f"{cell(r['peak_rss_mb'], '{:7.0f}MB', 9)} "
+          f"{cell(r['arena_ratio'], '{:5.2f}x', 6)} "
+          f"{cell(r['int8_ratio'], '{:4.2f}x', 5)}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]

@@ -1,0 +1,464 @@
+"""Factor arena + quantized device factors (ISSUE 9 tentpole).
+
+Covers the arena's storage semantics (grow/recycle/tombstone/compaction,
+the interned id index), host-delta composition vs full rebuild, the
+acceptance equivalences (f32 top-k bit-identical to a value-preserving
+dict store; int8 recall@10 ≥ 0.99 on planted-structure data against an
+EXACT brute-force reference), the arena/quantized telemetry gauges, and a
+serving-layer swap e2e asserting zero request-path compiles after a
+quantized-model handoff (the int8 warm ladder covers its own signatures).
+"""
+
+import json
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+from oryx_tpu.common import compilecache
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils
+from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.models.als.serving import ALSServingModel, _QuantSnapshot
+from oryx_tpu.models.als.vectors import FeatureVectorStore, _IdIndex
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+
+# ---------------------------------------------------------------------------
+# arena storage semantics
+# ---------------------------------------------------------------------------
+
+
+def test_arena_grows_by_doubling_and_preserves_values():
+    s = FeatureVectorStore(initial_rows=4)
+    for i in range(100):
+        s.set_vector(f"i{i}", np.full(3, i, dtype=np.float32))
+    assert s.size() == 100
+    # capacity is the next power of two, not 100 reallocation steps
+    assert s._slab.shape[0] == 128
+    for i in range(100):
+        assert s.get_vector(f"i{i}")[0] == i
+    assert s.ids() == [f"i{i}" for i in range(100)]
+
+
+def test_removed_rows_repack_without_capacity_growth():
+    s = FeatureVectorStore(initial_rows=4)
+    for i in range(8):
+        s.set_vector(f"i{i}", np.full(2, i, dtype=np.float32))
+    cap = s._slab.shape[0]
+    s.remove_vector("i3")
+    s.remove_vector("i5")
+    assert s.size() == 6 and s.get_vector("i3") is None
+    # removal re-packs survivors (rows are never recycled in place — the
+    # pinned-snapshot invariant), so two inserts fit the freed capacity
+    s.set_vector("n1", np.full(2, 91, dtype=np.float32))
+    s.set_vector("n2", np.full(2, 92, dtype=np.float32))
+    assert s._slab.shape[0] == cap
+    assert s.size() == 8
+    assert s.get_vector("n1")[0] == 91 and s.get_vector("n2")[0] == 92
+    # the survivors are untouched by the re-pack
+    for i in (0, 1, 2, 4, 6, 7):
+        assert s.get_vector(f"i{i}")[0] == i
+
+
+def test_retain_gc_compacts_slab():
+    s = FeatureVectorStore(initial_rows=4)
+    s.bulk_load([f"x{i}" for i in range(512)],
+                np.arange(512 * 2, dtype=np.float32).reshape(512, 2))
+    cap_before = s.arena_nbytes()
+    # nothing is "recent" after an explicit clear, so retain drops the rest
+    s._recent[:] = False
+    s.retain_recent_and_ids({"x1", "x500"})
+    assert s.size() == 2
+    assert s.arena_nbytes() < cap_before  # slab re-packed, not just tombstoned
+    assert s.get_vector("x500")[0] == 1000.0
+    assert set(s.ids()) == {"x1", "x500"}
+    # the store keeps working after compaction (rows re-bound)
+    s.set_vector("x999", np.full(2, 7, dtype=np.float32))
+    assert s.get_vector("x999")[0] == 7
+
+
+def test_id_index_collisions_and_deletes():
+    """Force a tiny table through many insert/delete cycles: linear-probe
+    chains must survive tombstones and resizes."""
+    idx = _IdIndex(capacity=4)
+    for i in range(200):
+        idx.add(f"key-{i}", i)
+    assert all(idx.lookup(f"key-{i}") == i for i in range(200))
+    assert idx.lookup("absent") == -1
+    for i in range(0, 200, 3):
+        assert idx.delete(f"key-{i}") == i
+    for i in range(200):
+        want = -1 if i % 3 == 0 else i
+        assert idx.lookup(f"key-{i}") == want
+    # re-adding a deleted key reuses tombstoned table slots
+    idx.add("key-0", 0)
+    assert idx.lookup("key-0") == 0
+    assert all(idx.decode(i) == f"key-{i}" for i in (1, 2, 199))
+
+
+def test_id_index_delete_churn_never_wedges():
+    """Tombstones count toward the probe table's load factor: sustained
+    add/delete churn (speed-layer id turnover, per-generation GC) must
+    never exhaust the empty slots that terminate a probe — before the
+    round-9 review fix, ~94 cycles on a fresh table made any lookup of an
+    absent id spin forever under the store lock."""
+    idx = _IdIndex(capacity=4)
+    for i in range(2000):  # >> any table size reached here
+        idx.add(f"churn-{i}", i % 8)
+        assert idx.delete(f"churn-{i}") == i % 8
+        assert idx.lookup("never-present") == -1  # must terminate
+    idx.add("survivor", 3)
+    assert idx.lookup("survivor") == 3
+
+
+def test_quant_rescore_view_survives_concurrent_gc():
+    """The exact-rescore gather is pinned to the SNAPSHOT's slab view: a
+    structural store change (retain GC / compaction) mid-request must
+    neither crash the gather nor misalign candidate rows (review finding:
+    the live-order gather IndexError'd on an emptied store and silently
+    paired ids with other rows' factors after GC)."""
+    rng = np.random.default_rng(21)
+    n, k = 300, 8
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    m = ALSServingModel(k, implicit=True, device_dtype="int8")
+    m.bulk_load_items([f"i{i}" for i in range(n)], y)
+    snap = m.y_snapshot()
+    before = snap.gather_rows(np.arange(10))
+    np.testing.assert_array_equal(before, y[:10])
+    # structural change: GC the live store down to nothing mid-request
+    m.y._recent[:] = False
+    m.y.retain_recent_and_ids(set())
+    assert m.y.size() == 0
+    after = snap.gather_rows(np.arange(10))  # neither crash nor misalign
+    np.testing.assert_array_equal(after, y[:10])
+    # the sharpest form (round-2 review): a same-features handoff refills
+    # the SAME store with NEW ids right after the GC — before rows moved to
+    # a fresh slab on every structural change, the refill recycled the
+    # freed rows in place and the pinned view silently served the new ids'
+    # factors for the old candidates
+    z = 100 + rng.standard_normal((n, k)).astype(np.float32)
+    m.y.bulk_load([f"gen2-{i}" for i in range(n)], z)
+    assert m.y.size() == n
+    np.testing.assert_array_equal(snap.gather_rows(np.arange(10)), y[:10])
+
+
+def test_bulk_load_collapses_duplicate_ids_last_wins():
+    """A handoff carrying a duplicate id must collapse it last-wins (the
+    pre-arena dict semantics) — the fast path's positional adds used to
+    leave BOTH rows live, scoring the stale first occurrence forever."""
+    s = FeatureVectorStore()
+    s.bulk_load(["a", "b", "a"],
+                np.arange(6, dtype=np.float32).reshape(3, 2))
+    assert s.size() == 2
+    assert s.ids() == ["a", "b"]
+    assert s.get_vector("a")[0] == 4.0  # the LAST occurrence
+    ids, host, _, _ = s.host_matrix()
+    assert ids == ["a", "b"] and host.shape == (2, 2)
+
+
+def test_width_change_is_rejected():
+    s = FeatureVectorStore()
+    s.set_vector("a", np.zeros(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="width"):
+        s.set_vector("b", np.zeros(5, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# host delta composition (the int8 snapshot's feed)
+# ---------------------------------------------------------------------------
+
+
+def test_host_delta_composes_and_matches_full_rebuild():
+    rng = np.random.default_rng(3)
+    s = FeatureVectorStore()
+    s.bulk_load([f"i{i}" for i in range(50)],
+                rng.standard_normal((50, 4)).astype(np.float32))
+    ids0, host0, v0, _ = s.host_matrix()
+    # several separate point-update batches compose into ONE delta
+    s.set_vector("i7", np.full(4, 1, dtype=np.float32))
+    s.set_vector("i7", np.full(4, 2, dtype=np.float32))  # newest wins
+    s.set_vector("i9", np.full(4, 3, dtype=np.float32))
+    s.set_vector("new-a", np.full(4, 4, dtype=np.float32))
+    s.set_vector("new-b", np.full(4, 5, dtype=np.float32))
+    d = s.delta_info(v0, len(ids0))
+    assert d is not None
+    assert sorted(d.changed_ids) == ["i7", "i9"]
+    assert d.appended_ids == ["new-a", "new-b"]
+    vals = dict(zip(d.changed_ids, d.changed_vals))
+    assert vals["i7"][0] == 2 and vals["i9"][0] == 3
+    assert d.appended_vals[0][0] == 4 and d.appended_vals[1][0] == 5
+    # applying the delta onto host0 reproduces the full rebuild bit-for-bit
+    rebuilt = np.concatenate([host0, d.appended_vals])
+    pos = {id_: i for i, id_ in enumerate(ids0)}
+    for id_, val in vals.items():
+        rebuilt[pos[id_]] = val
+    ids1, host1, _, _ = s.host_matrix()
+    assert ids1 == ids0 + d.appended_ids
+    np.testing.assert_array_equal(rebuilt, host1)
+
+
+def test_host_delta_cut_by_structural_change():
+    s = FeatureVectorStore()
+    s.bulk_load(["a", "b"], np.zeros((2, 3), dtype=np.float32))
+    _, _, v0, _ = s.host_matrix()
+    s.remove_vector("a")
+    assert s.delta_info(v0, 2) is None  # removal is structural
+
+
+# ---------------------------------------------------------------------------
+# acceptance equivalences
+# ---------------------------------------------------------------------------
+
+
+def test_f32_arena_topk_bit_identical_to_dict_store():
+    """The arena must be value-preserving: the device matrix it materializes
+    is bit-identical to the loaded factors (what the dict store held), and
+    bulk-load vs per-id set_vector models answer top-k IDENTICALLY — so the
+    f32 query path is bit-for-bit what the dict store produced."""
+    rng = np.random.default_rng(11)
+    n, k = 3000, 24
+    ids = [f"i{i}" for i in range(n)]
+    y = rng.standard_normal((n, k)).astype(np.float32)
+
+    bulk = ALSServingModel(k, implicit=True, device_dtype="float32")
+    bulk.bulk_load_items(ids, y)
+    pointwise = ALSServingModel(k, implicit=True, device_dtype="float32")
+    for i, id_ in enumerate(ids):
+        pointwise.set_item_vector(id_, y[i])
+
+    # the arena never perturbed a value on its way to the device
+    np.testing.assert_array_equal(np.asarray(bulk.y_snapshot().mat), y)
+    np.testing.assert_array_equal(np.asarray(pointwise.y_snapshot().mat), y)
+
+    qs = rng.standard_normal((32, k)).astype(np.float32)
+    a = bulk.top_n_batch(qs, 10)
+    b = pointwise.top_n_batch(qs, 10)
+    assert a == b  # ids AND float scores exactly equal
+
+
+def test_quantized_recall_at_10_on_planted_structure():
+    """Planted structure: items cluster around known centers and queries ARE
+    the centers, so the true top-10 is unambiguous. The int8 path (quantized
+    scan + exact f32 rescore at the default rescore-factor) must hit
+    recall@10 ≥ 0.99 against an EXACT numpy brute-force reference."""
+    rng = np.random.default_rng(5)
+    n, k, n_centers = 8000, 32, 64
+    centers = rng.standard_normal((n_centers, k)).astype(np.float32)
+    assign = rng.integers(0, n_centers, n)
+    y = (centers[assign] + 0.3 * rng.standard_normal((n, k))).astype(np.float32)
+    ids = [f"i{i}" for i in range(n)]
+
+    q8 = ALSServingModel(k, implicit=True, device_dtype="int8")
+    q8.bulk_load_items(ids, y)
+    got = q8.top_n_batch(centers, 10)
+
+    exact = y @ centers.T  # (n, n_centers), float32 brute force
+    recalls = []
+    for c in range(n_centers):
+        truth = {f"i{i}" for i in np.argsort(-exact[:, c])[:10]}
+        recalls.append(len(truth & {i for i, _ in got[c]}) / 10.0)
+    assert np.mean(recalls) >= 0.99, np.mean(recalls)
+    # and the returned scores are EXACT f32 dots (rescored from the arena),
+    # not dequantized approximations
+    for id_, score in got[0]:
+        row = int(id_[1:])
+        assert abs(score - float(exact[row, 0])) < 1e-4
+
+
+def test_quant_incremental_snapshot_equals_full_rebuild():
+    rng = np.random.default_rng(7)
+    n, k = 500, 16
+    m = ALSServingModel(k, implicit=True, device_dtype="int8")
+    m.bulk_load_items([f"i{i}" for i in range(n)],
+                      rng.standard_normal((n, k)).astype(np.float32))
+    snap0 = m.y_snapshot()
+    assert isinstance(snap0, _QuantSnapshot)
+    for i in (3, 99, 250):
+        m.set_item_vector(f"i{i}", rng.standard_normal(k).astype(np.float32))
+    m.set_item_vector("fresh", rng.standard_normal(k).astype(np.float32))
+    snap1 = m.y_snapshot()
+    assert snap1.n == n + 1 and snap1.ids[-1] == "fresh"
+
+    fresh = ALSServingModel(k, implicit=True, device_dtype="int8")
+    fresh.bulk_load_items(
+        snap1.ids, np.stack([m.y.get_vector(i) for i in snap1.ids])
+    )
+    snap_f = fresh.y_snapshot()
+    np.testing.assert_array_equal(np.asarray(snap1.qmat), np.asarray(snap_f.qmat))
+    np.testing.assert_array_equal(np.asarray(snap1.qscale),
+                                  np.asarray(snap_f.qscale))
+    np.testing.assert_array_equal(np.asarray(snap1.norms),
+                                  np.asarray(snap_f.norms))
+
+
+def test_quant_exclusions_and_lsh_paths():
+    rng = np.random.default_rng(13)
+    n, k = 2000, 16
+    ids = [f"i{i}" for i in range(n)]
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    q8 = ALSServingModel(k, implicit=True, device_dtype="int8")
+    q8.bulk_load_items(ids, y)
+    q = rng.standard_normal(k).astype(np.float32)
+    base = [i for i, _ in q8.top_n(q, 5)]
+    excluded = base[:2]
+    got = q8.top_n(q, 5, excluded=excluded)
+    assert not set(excluded) & {i for i, _ in got}
+    # LSH masking composes with quantization
+    lsh = ALSServingModel(k, implicit=True, sample_rate=0.5,
+                          device_dtype="int8")
+    lsh.bulk_load_items(ids, y)
+    res = lsh.top_n_batch(rng.standard_normal((4, k)).astype(np.float32), 5)
+    assert all(len(r) == 5 for r in res)
+    # cosine /similarity path answers on the quantized snapshot too
+    cos = q8.top_n_cosine(np.stack([y[3], y[8]]), 5)
+    assert len(cos) == 5
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_arena_and_quantized_gauges():
+    registry = metrics_mod.default_registry()
+    rng = np.random.default_rng(1)
+    n, k = 1000, 8
+    m = ALSServingModel(k, implicit=True, device_dtype="int8")
+    m.bulk_load_items([f"i{i}" for i in range(n)],
+                      rng.standard_normal((n, k)).astype(np.float32))
+    snap = m.y_snapshot()  # registers the quantized provider
+    snapshot = registry.snapshot()
+    arena_bytes = snapshot.get("oryx_factor_arena_bytes", {}).get("", 0)
+    # this store's slab is counted (other live stores may add to it)
+    assert arena_bytes >= m.y.arena_nbytes() > 0
+    fill = snapshot.get("oryx_factor_arena_fill_fraction", {}).get("", 0)
+    assert 0.0 < fill <= 1.0
+    quant_bytes = snapshot.get("oryx_device_quantized_factor_bytes", {}).get("", 0)
+    assert quant_bytes >= snap.quantized_nbytes() > 0
+    # int8 slab + f32 scales ≈ (k + 4) bytes/row — a quarter of f32's 4k
+    assert snap.quantized_nbytes() == n * k + n * 4
+
+
+# ---------------------------------------------------------------------------
+# quantized-model handoff: zero request-path compiles (swap e2e)
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_handoff_zero_compiles_after_swap(tmp_path):
+    """device-dtype=int8 + precompile-batches: a MODEL handoff (and a
+    staged generation swap) must leave the first post-handoff /recommend
+    burst compile-free — the warm ladder covers the QUANTIZED signatures
+    (their own AOT cost keys), exclusion-carrying form included."""
+    from test_compilecache import _publish, _train_model
+
+    tp.reset_memory_brokers()
+    compilecache.warmup_state().reset()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.serving.compute.precompile-batches": True,
+            "oryx.serving.compute.coalesce-max-batch": 8,
+            "oryx.serving.device-dtype": "int8",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    gen1_dir = tmp_path / "gen1"
+    gen1_dir.mkdir()
+    pmml1, known1 = _train_model(gen1_dir, features=4, seed=0)
+    _publish(pmml1, gen1_dir, known1)
+    layer = ServingLayer(config)
+    layer.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with httpx.Client(base_url=base, timeout=60) as client:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if (client.get("/readyz").status_code == 200
+                        and layer._warmer.warmed_models >= 1):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("gen1 never became warm-ready")
+            model = layer.manager.get_model()
+            assert model.device_dtype == "int8"
+            assert isinstance(model.y_snapshot(), _QuantSnapshot)
+
+            # a second generation with NEW shapes stages, warms off-path
+            # (the quantized ladder), and promotes
+            gen2_dir = tmp_path / "gen2"
+            gen2_dir.mkdir()
+            pmml2, known2 = _train_model(gen2_dir, features=5, seed=1)
+            _publish(pmml2, gen2_dir, known2)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if layer.manager.get_model().features == 5:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("staged quantized generation never promoted")
+            assert layer._warmer.promoted_models >= 1
+
+            # settle off-path stragglers, then assert the burst (default
+            # endpoint = exclusion-carrying + the exclusion-free form)
+            # compiles NOTHING
+            layer.manager.get_model().get_yty_solver()
+            client.get("/recommend/u0?considerKnownItems=true")
+            c0 = compilecache.compiles_total()
+            for i in range(10):
+                r = client.get(f"/recommend/u{i}")
+                assert r.status_code == 200
+                assert all(
+                    rec["id"] not in known2.get(f"u{i}", [])
+                    for rec in r.json()
+                )
+            for i in range(5):
+                r = client.get(f"/recommend/u{i}?considerKnownItems=true")
+                assert r.status_code == 200
+            assert compilecache.compiles_total() - c0 == 0, (
+                "request-path compile after quantized-model handoff"
+            )
+    finally:
+        layer.close()
+        tp.reset_memory_brokers()
+        compilecache.warmup_state().reset()
+
+
+def test_bench_store_memory_probe_arena_within_bound():
+    """The acceptance bound at a tier-1-friendly shape: the arena store's
+    peak RSS delta stays ≤ 1.5× raw factor bytes (+ a small fixed allowance
+    for interpreter noise at this size), where the dict store measured
+    ~2.3×. The 1M×50f number is published by `bench.py --serving`."""
+    import json as json_mod
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"),
+         "--store-memory", "arena", "400000", "50"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json_mod.loads(proc.stdout.strip().splitlines()[-1])
+    assert "error" not in out, out
+    raw_mb = out["raw_mb"]
+    # steady-state is the sharp signal: the arena measures 1.27-1.33× where
+    # the dict store measured 2.24× — a return to per-key object overhead
+    # adds ~0.9× raw and trips this immediately
+    assert out["rss_delta_ratio_to_raw"] <= 1.6, out
+    # peak carries a ~40 MB absolute transient floor (chunk buffers +
+    # allocator retention) that dwarfs proportional noise at this shape;
+    # at 1M×50f the published bench number is 1.46×
+    assert out["peak_delta_mb"] <= 1.5 * raw_mb + 48, out

@@ -16,17 +16,18 @@ from oryx_tpu.models.als.vectors import FeatureVectorStore
 
 @pytest.fixture
 def counting_stack(monkeypatch):
-    """Counts rows passing through np.stack inside vectors.py — the full
-    rebuild stacks ALL vectors; the incremental path only the delta."""
+    """Counts rows passing through the arena's host→device gather seam
+    (vectors._host_gather) — the full rebuild gathers ALL live rows; the
+    incremental path only the delta."""
     counts = []
-    orig = np.stack
+    orig = vmod._host_gather
 
-    def counting(arrays, *a, **kw):
-        arrays = list(arrays)
-        counts.append(len(arrays))
-        return orig(arrays, *a, **kw)
+    def counting(slab, rows):
+        out = orig(slab, rows)
+        counts.append(len(out))
+        return out
 
-    monkeypatch.setattr(vmod.np, "stack", counting)
+    monkeypatch.setattr(vmod, "_host_gather", counting)
     return counts
 
 
@@ -109,9 +110,9 @@ def test_removal_forces_rebuild(counting_stack):
 
 
 def test_delta_chain_survives_interleaved_consumers():
-    """get_vtv (the solver cache) consuming pending batches between snapshot
-    reads must NOT force the snapshot back to a full rebuild: deltas compose
-    across generations."""
+    """Other consumers (get_vtv, now slab-host-based) running between
+    snapshot reads must NOT force the snapshot back to a full rebuild:
+    deltas compose across any number of store versions."""
     store, _ = _loaded_store(n=100)
     _, mat0 = store.materialize()
     store.set_vector("i5", np.ones(8, dtype=np.float32))

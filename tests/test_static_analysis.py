@@ -713,6 +713,86 @@ def test_swallowed_exception_quiet_on_netbroker_dispatch_shape():
 
 
 # ---------------------------------------------------------------------------
+# per-row-ndarray-store
+# ---------------------------------------------------------------------------
+
+
+def test_per_row_store_fires_on_dict_of_ndarray_accumulation():
+    """The pre-arena host-store shape: a dict attribute accumulating one
+    ndarray per id (both the direct-call and the one-hop-local forms)."""
+    hits = _run(
+        """
+        import numpy as np
+
+        class VectorMap:
+            def __init__(self):
+                self._vectors = {}
+
+            def set_vector(self, id_, vec):
+                v = np.asarray(vec, dtype=np.float32)
+                self._vectors[id_] = v          # one-hop local inference
+
+            def set_copy(self, id_, vec):
+                self._vectors[id_] = vec.astype(np.float32)  # method expr
+
+            def set_chained(self, id_, vec):
+                v = np.asarray(vec)
+                self._vectors[id_] = v.copy()   # .copy() of a known array
+        """,
+        "per-row-ndarray-store",
+        filename="oryx_tpu/models/fixture.py",
+    )
+    assert len(hits) == 3
+    assert all("arena" in f.message for f in hits)
+    assert {f.symbol for f in hits} == {
+        "VectorMap.set_vector:_vectors", "VectorMap.set_copy:_vectors",
+        "VectorMap.set_chained:_vectors",
+    }
+
+
+def test_per_row_store_quiet_on_arena_idiom_and_cold_paths():
+    """Near-misses stay silent: row-INDEX dicts + slab row writes (the
+    arena idiom), non-dict attributes, and the same shape outside the
+    models/serving hot paths."""
+    src = """
+        import numpy as np
+
+        class Arena:
+            def __init__(self):
+                self._rows = {}
+                self._slab = np.zeros((16, 4), dtype=np.float32)
+                self._meta: dict[str, int] = {}
+
+            def set_vector(self, id_, vec):
+                v = np.asarray(vec, dtype=np.float32)
+                row = self._rows.get(id_, len(self._rows))
+                self._rows[id_] = row        # int into a dict: fine
+                self._slab[row] = v          # slab row write: the idiom
+                self._meta[id_] = int(v.shape[0])
+
+            def remember(self, user, known):
+                # a SET copied into a dict — .copy() of a non-array
+                # receiver must not fire
+                self._meta[user] = known.copy()
+    """
+    assert _run(src, "per-row-ndarray-store",
+                filename="oryx_tpu/models/fixture.py") == []
+    # identical accumulation OUTSIDE the hot paths is someone else's call
+    cold = """
+        import numpy as np
+
+        class Cache:
+            def __init__(self):
+                self._arrs = {}
+
+            def put(self, k, v):
+                self._arrs[k] = np.asarray(v)
+    """
+    assert _run(cold, "per-row-ndarray-store",
+                filename="oryx_tpu/tools/fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
