@@ -9,3 +9,15 @@ microbatch kernels.
 """
 
 __version__ = "0.1.0"
+
+# Runtime concurrency sanitizer opt-in (ORYX_SANITIZE=locks,loop): install
+# at package import, BEFORE any oryx module allocates its locks or spins up
+# an event loop — subprocess layers (fleet replicas, the cli broker)
+# inherit the env var and self-install the same way. Stdlib-only import;
+# a no-op when the variable is unset (see docs/sanitizer.md).
+import os as _os
+
+if _os.environ.get("ORYX_SANITIZE"):
+    from oryx_tpu.tools import sanitize as _sanitize
+
+    _sanitize.install_from_env()

@@ -310,6 +310,21 @@ oryx = {
     spec = null
   }
 
+  # Runtime concurrency sanitizer (tools/sanitize): opt-in via the
+  # ORYX_SANITIZE=locks,loop environment variable (it must install before
+  # any lock is allocated, so the MODE cannot live in config); these keys
+  # tune the installed sanitizer's thresholds (docs/sanitizer.md).
+  sanitize = {
+    # Event-loop stall watchdog: an asyncio callback blocking the loop
+    # longer than this gets its live stack dumped while still blocked.
+    # ORYX_SANITIZE_LOOP_STALL_MS overrides (pre-config processes).
+    loop-stall-ms = 250
+    # Lock-hold outlier threshold: a repo lock held longer than this is
+    # reported at exit (information, not a gate — convoy tuning signal).
+    # ORYX_SANITIZE_LONG_HOLD_MS overrides.
+    long-hold-ms = 250
+  }
+
   # Device-performance attribution (common/profiling.py): per-program XLA
   # cost accounting feeding oryx_device_flops_total and the scrape-time
   # MFU / HBM-bandwidth gauges, device + host memory telemetry, and the

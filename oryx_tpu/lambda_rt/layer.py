@@ -75,6 +75,11 @@ class AbstractLayer:
         from oryx_tpu.models.als import vectors as als_vectors
 
         als_vectors.configure(config)
+        # sanitizer thresholds (oryx.sanitize.*; a threshold tune when
+        # ORYX_SANITIZE installed the sanitizer at import, a no-op else)
+        from oryx_tpu.tools import sanitize
+
+        sanitize.configure(config)
         self.tracer = StepTracer(config, tier)
         self.id = config.get_string("oryx.id", None)
         self.input_broker = config.get_string("oryx.input-topic.broker")

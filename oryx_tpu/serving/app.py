@@ -14,9 +14,12 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import concurrent.futures
+import contextlib
 import hashlib
 import hmac
 import importlib
+import os
 import re
 import secrets
 import ssl
@@ -333,6 +336,11 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     # (after the others: jax is imported by now, so peak auto-detection and
     # per-device gauge wiring can see the live backend)
     profiling.configure(config)
+    # concurrency-sanitizer thresholds (oryx.sanitize.*): install happened
+    # at import when ORYX_SANITIZE was set; this only tunes thresholds
+    from oryx_tpu.tools import sanitize
+
+    sanitize.configure(config)
     middlewares = [_metrics_middleware, rsrc.error_middleware, _compression_middleware]
     dl_mw = _deadline_middleware(config)
     if dl_mw is not None:
@@ -893,6 +901,23 @@ class ServingLayer:
             loop = asyncio.new_event_loop()
             self._loop = loop
             asyncio.set_event_loop(loop)
+            # pre-started default executor: the lazily-created one spawns
+            # its worker threads on FIRST use, and Thread.start() blocks
+            # until the OS schedules the new thread — under CPU contention
+            # that is a several-hundred-ms EVENT-LOOP stall on the first
+            # coalescer dispatch per worker (caught live by the sanitizer's
+            # loop watchdog). Spawning here, off the request path, makes
+            # every later run_in_executor hop a queue push.
+            executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, (os.cpu_count() or 4)),
+                thread_name_prefix="oryx-serving-exec",
+            )
+            barrier = threading.Barrier(executor._max_workers + 1)
+            for _ in range(executor._max_workers):
+                executor.submit(barrier.wait, 10)
+            with contextlib.suppress(threading.BrokenBarrierError):
+                barrier.wait(10)  # all workers alive before serving starts
+            loop.set_default_executor(executor)
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())
             site = web.TCPSite(runner, "0.0.0.0", bind_port, ssl_context=sslctx)
@@ -904,6 +929,7 @@ class ServingLayer:
                 loop.run_forever()
             finally:
                 loop.run_until_complete(runner.cleanup())
+                executor.shutdown(wait=False)
                 loop.close()
 
         self._server_thread = threading.Thread(target=serve, name="OryxServingLayer", daemon=True)

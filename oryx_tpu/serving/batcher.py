@@ -367,6 +367,7 @@ class TopNCoalescer:
             spans.finish_span(call_span)
             loop.call_soon_threadsafe(self._done, loop)
             return
+        span_finished = False
         try:
             with spans.activate(call_span):
                 faults.maybe_fail("serving.device_call")
@@ -402,6 +403,14 @@ class TopNCoalescer:
                 results = model.top_n_batch(qs, want, alloweds, excluded)
             if self.breaker is not None:
                 self.breaker.record_success()
+            # trace completeness: the call span must land in the ring
+            # BEFORE any waiter's future resolves — a client that has its
+            # response may immediately fetch GET /trace?trace_id=, and a
+            # trace missing its device call there is a torn read (the
+            # sanitized suite widened this executor-side race enough to
+            # observe it)
+            span_finished = True
+            spans.finish_span(call_span)
             for p, res in zip(group, results):
                 out = res[p.offset:p.offset + p.how_many]
                 loop.call_soon_threadsafe(_set_result, p.future, out)
@@ -409,13 +418,17 @@ class TopNCoalescer:
             if self.breaker is not None:
                 self.breaker.record_failure()
             call_span.record_exception(e)
+            if not span_finished:
+                span_finished = True
+                spans.finish_span(call_span)  # same ordering on the error path
             log.exception(
                 "coalesced top-N batch failed; retrying its %d request(s) "
                 "individually", len(group),
             )
             self._fallback_individually(loop, model, group, e)
         finally:
-            spans.finish_span(call_span)
+            if not span_finished:
+                spans.finish_span(call_span)
             loop.call_soon_threadsafe(self._done, loop)
 
     def _fallback_individually(self, loop, model, group: list[_Pending],

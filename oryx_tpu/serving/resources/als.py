@@ -218,13 +218,19 @@ async def similarity_to_item(request: web.Request) -> web.Response:
     items = split_path_list(request.match_info["items"])
     to_vec = check_exists(model.get_item_vector(to_item), to_item)
     norm_to = float(np.linalg.norm(to_vec))
-    out = []
+    vecs = []
     for i in items:
         v = model.get_item_vector(i)
         check_exists(v, i)
-        sim = float(vm.cosine_similarity(v, to_vec, norm_to))
-        out.append(id_value(i, sim))
-    return render(request, out)
+        vecs.append(v)
+    # the jnp dispatch (and its first-call XLA compile, ~600 ms) must not
+    # run on the event loop — the sanitizer's loop-stall watchdog caught
+    # exactly that here; one executor hop covers the whole pair list
+    sims = await _run(
+        request,
+        lambda: [float(vm.cosine_similarity(v, to_vec, norm_to)) for v in vecs],
+    )
+    return render(request, [id_value(i, s) for i, s in zip(items, sims)])
 
 
 async def estimate(request: web.Request) -> web.Response:
@@ -261,9 +267,15 @@ async def because(request: web.Request) -> web.Response:
     if not known_vecs:
         return render(request, [])
     norm = float(np.linalg.norm(item_vec))
-    sims = [
-        (i, float(vm.cosine_similarity(v, item_vec, norm))) for i, v in known_vecs
-    ]
+    # same loop-stall hazard as similarity_to_item: per-pair jnp dispatch
+    # off the event loop in one hop
+    sims = await _run(
+        request,
+        lambda: [
+            (i, float(vm.cosine_similarity(v, item_vec, norm)))
+            for i, v in known_vecs
+        ],
+    )
     sims.sort(key=lambda t: -t[1])
     return render(request, [id_value(i, s) for i, s in sims[offset:offset + how_many]])
 

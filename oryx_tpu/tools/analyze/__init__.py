@@ -9,6 +9,12 @@ that layer for the failure modes this codebase actually has (VERDICT r5):
   * ``tracer-leak``        — host concretization of traced values
   * ``blocking-async``     — event-loop stalls in serving handlers
   * ``lock-discipline``    — shared state written under a lock, read without
+  * ``lock-order-cycle``   — interprocedural lock-acquisition-order cycles
+                             (potential deadlocks, both paths reported)
+  * ``blocking-under-lock``— await/sleep/executor/socket work (or an
+                             unbounded spin) while a threading lock is held
+  * ``shared-state-escape``— attributes written from both thread and
+                             event-loop context with no common lock
   * ``config-key-drift``   — oryx.* keys read but undeclared, or declared but
                              never read
   * ``float64-promotion``  — float64 constants flowing into jitted numerics

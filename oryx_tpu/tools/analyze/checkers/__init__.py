@@ -5,6 +5,11 @@ from oryx_tpu.tools.analyze.checkers.tracer import TracerLeakChecker
 from oryx_tpu.tools.analyze.checkers.blocking import BlockingAsyncChecker
 from oryx_tpu.tools.analyze.checkers.hotcompile import HotPathCompileChecker
 from oryx_tpu.tools.analyze.checkers.locks import LockDisciplineChecker
+from oryx_tpu.tools.analyze.checkers.concurrency import (
+    BlockingUnderLockChecker,
+    LockOrderCycleChecker,
+    SharedStateEscapeChecker,
+)
 from oryx_tpu.tools.analyze.checkers.confkeys import ConfigKeyDriftChecker
 from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
 from oryx_tpu.tools.analyze.checkers.logstyle import LogDisciplineChecker
@@ -17,6 +22,9 @@ ALL_CHECKERS = (
     BlockingAsyncChecker(),
     HotPathCompileChecker(),
     LockDisciplineChecker(),
+    LockOrderCycleChecker(),
+    BlockingUnderLockChecker(),
+    SharedStateEscapeChecker(),
     ConfigKeyDriftChecker(),
     Float64PromotionChecker(),
     LogDisciplineChecker(),

@@ -29,12 +29,7 @@ from __future__ import annotations
 
 import ast
 
-from oryx_tpu.tools.analyze.core import (
-    call_edges,
-    method_classes,
-    module_map,
-    walk_scope,
-)
+from oryx_tpu.tools.analyze.core import scope_nodes
 
 ID = "blocking-async"
 
@@ -86,36 +81,20 @@ class BlockingAsyncChecker:
     id = ID
 
     def check(self, project) -> list:
-        # -- pass 1: per-function direct blocking facts + call edges --------
-        module_of = module_map(project)
+        # -- pass 1: per-function direct blocking facts over the SHARED
+        # project call graph (built once per run, core.CallGraph) ----------
+        graph = project.call_graph()
+        edges = graph.edges
+        async_keys = graph.async_keys
 
         facts = {}  # (relpath, qualname) -> (line, cause) | None
-        edges = {}  # (relpath, qualname) -> list[(call_line, callee_key, label)]
-        async_keys = set()
-
-        for fctx in project.files:
-            fn_class = method_classes(fctx)
-            for qual, fn in fctx.functions:
-                key = (fctx.relpath, qual)
-                if isinstance(fn, ast.AsyncFunctionDef):
-                    async_keys.add(key)
-                facts[key] = self._direct_fact(fctx, fn)
-                edges[key] = call_edges(fctx, fn, fn_class, module_of)
+        for key, (fctx, fn) in graph.functions.items():
+            facts[key] = self._direct_fact(fctx, fn)
 
         # -- pass 2: propagate blocking through the call graph --------------
-        blocking = {k: v for k, v in facts.items() if v is not None}
-        changed = True
-        while changed:
-            changed = False
-            for key, outs in edges.items():
-                if key in blocking:
-                    continue
-                for line, callee, label in outs:
-                    if callee in blocking:
-                        _, cause = blocking[callee]
-                        blocking[key] = (line, f"{label} -> {cause}")
-                        changed = True
-                        break
+        blocking = graph.propagate(
+            {k: v for k, v in facts.items() if v is not None}
+        )
 
         # -- report: async functions only -----------------------------------
         out = []
@@ -148,7 +127,7 @@ class BlockingAsyncChecker:
 
     # -- fact/edge extraction ------------------------------------------------
     def _direct_fact(self, fctx, fn):
-        for node in walk_scope(fn):
+        for node in scope_nodes(fctx, fn):
             if isinstance(node, ast.With):
                 for item in node.items:
                     ids = [s.lower() for s in _identifiers(item.context_expr)]

@@ -23,12 +23,7 @@ from __future__ import annotations
 
 import ast
 
-from oryx_tpu.tools.analyze.core import (
-    call_edges,
-    method_classes,
-    module_map,
-    walk_scope,
-)
+from oryx_tpu.tools.analyze.core import scope_nodes
 
 ID = "compile-on-hot-path"
 
@@ -43,38 +38,28 @@ class HotPathCompileChecker:
     id = ID
 
     def check(self, project) -> list:
-        module_of = module_map(project)
+        # the SHARED project call graph (core.CallGraph, built once per run)
+        # with this checker's exemption applied at use time: edges into (and
+        # facts inside) the warmup subsystem are dropped, never mutated on
+        # the shared structure
+        graph = project.call_graph()
+        async_keys = graph.async_keys
 
         facts = {}   # key -> (line, cause) | None
         edges = {}   # key -> [(line, callee_key, label)]
-        async_keys = set()
-        for fctx in project.files:
+        for key, (fctx, fn) in graph.functions.items():
             exempt_file = fctx.relpath.endswith("common/compilecache.py")
-            fn_class = method_classes(fctx)
-            for qual, fn in fctx.functions:
-                key = (fctx.relpath, qual)
-                if isinstance(fn, ast.AsyncFunctionDef):
-                    async_keys.add(key)
-                facts[key] = None if exempt_file else self._direct_fact(fctx, fn)
-                edges[key] = [] if exempt_file else [
-                    e for e in call_edges(fctx, fn, fn_class, module_of)
-                    if not e[1][0].endswith("common/compilecache.py")
-                ]
+            facts[key] = None if exempt_file else self._direct_fact(fctx, fn)
+            edges[key] = [] if exempt_file else [
+                e for e in graph.edges[key]
+                if not e[1][0].endswith("common/compilecache.py")
+            ]
 
-        # propagate "compiles" through the call graph
-        compiling = {k: v for k, v in facts.items() if v is not None}
-        changed = True
-        while changed:
-            changed = False
-            for key, outs in edges.items():
-                if key in compiling:
-                    continue
-                for line, callee, label in outs:
-                    if callee in compiling:
-                        _, cause = compiling[callee]
-                        compiling[key] = (line, f"{label} -> {cause}")
-                        changed = True
-                        break
+        # propagate "compiles" through the shared closure, over THIS
+        # checker's filtered edges
+        compiling = graph.propagate(
+            {k: v for k, v in facts.items() if v is not None}, edges=edges
+        )
 
         out = []
         for fctx in project.files:
@@ -108,7 +93,7 @@ class HotPathCompileChecker:
 
     @staticmethod
     def _direct_fact(fctx, fn):
-        for node in walk_scope(fn):
+        for node in scope_nodes(fctx, fn):
             if not isinstance(node, ast.Call):
                 continue
             resolved = fctx.resolve(node.func)

@@ -27,12 +27,55 @@ def _default_baseline(root: str) -> str:
     return os.path.join(root, "conf", "analyze-baseline.json")
 
 
+def _changed_relpaths(root: str) -> "set[str]":
+    """ROOT-relative .py files with uncommitted changes (worktree + index)
+    plus untracked files — the ``--changed`` pre-commit scope. git emits
+    paths relative to its TOP-LEVEL regardless of cwd, so they are
+    re-anchored onto ``root`` (finding paths are root-relative): in a
+    monorepo checkout a silent mismatch here would make the gate report
+    0 findings on real ones. Empty set when nothing changed; SystemExit 2
+    outside a git checkout."""
+    import subprocess
+
+    def run(cmd):
+        try:
+            return subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, check=True,
+                timeout=30,
+            ).stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            print(f"--changed needs a git checkout at {root}: {e}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
+    toplevel = run(["git", "rev-parse", "--show-toplevel"]).strip()
+    prefix = os.path.relpath(os.path.abspath(root), toplevel).replace(
+        os.sep, "/"
+    )
+    out: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        for line in run(cmd).splitlines():
+            p = line.strip()
+            if not p.endswith(".py"):
+                continue
+            if prefix not in (".", ""):
+                if not p.startswith(prefix + "/"):
+                    continue  # changed outside the analyze root
+                p = p[len(prefix) + 1:]
+            out.add(p)
+    return out
+
+
 def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="oryx-run analyze",
         description="AST static analysis for JAX/asyncio correctness "
         "(tracer leaks, recompile hazards, blocking-in-async, lock "
-        "discipline, config-key drift, float64 promotion)",
+        "discipline, lock-order cycles, blocking-under-lock, shared-state "
+        "escapes, config-key drift, float64 promotion)",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -57,6 +100,12 @@ def main(argv: "list[str] | None" = None) -> int:
         "--checker", action="append", dest="checkers", metavar="ID",
         help="run only the given checker id(s); repeatable",
     )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="report only findings in files changed per `git diff "
+        "--name-only HEAD` (plus untracked .py files) — the fast "
+        "pre-commit mode; the call graph still spans the whole project",
+    )
     args = parser.parse_args(argv)
 
     from oryx_tpu.tools.analyze.core import analyze_project, write_baseline
@@ -64,11 +113,31 @@ def main(argv: "list[str] | None" = None) -> int:
     default_paths, root = _default_paths()
     paths = args.paths or default_paths
     baseline_path = args.baseline or _default_baseline(root)
+    only_relpaths = None
+    if args.changed:
+        if args.update_baseline:
+            # write_baseline overwrites the whole file: scoped to a diff it
+            # would silently DROP every unchanged file's accepted entries
+            print("--update-baseline needs a full run (a --changed-scoped "
+                  "write would truncate other files' baseline entries)",
+                  file=sys.stderr)
+            return 2
+        only_relpaths = _changed_relpaths(root)
+        if not only_relpaths:
+            if args.format == "json":
+                print(json.dumps({
+                    "findings": [], "counts": {}, "total": 0,
+                    "unsuppressed": 0, "suppressed": 0, "parse_errors": [],
+                }, indent=2))
+            else:
+                print("0 finding(s) (no changed .py files)")
+            return 0
     result = analyze_project(
         paths,
         root=root,
         baseline_path=None if args.no_baseline else baseline_path,
         checkers=args.checkers,
+        only_relpaths=only_relpaths,
     )
 
     if args.update_baseline:

@@ -59,18 +59,41 @@ def module_name(relpath: str) -> str:
 
 
 def module_map(project) -> dict:
-    """Dotted module name -> FileContext for every file in the project."""
-    return {module_name(fctx.relpath): fctx for fctx in project.files}
+    """Dotted module name -> FileContext for every file in the project
+    (memoized on the project: every reachability checker needs it)."""
+    cached = getattr(project, "_module_map", None)
+    if cached is None:
+        cached = {module_name(fctx.relpath): fctx for fctx in project.files}
+        project._module_map = cached
+    return cached
 
 
 def method_classes(fctx) -> dict:
-    """Immediate method node -> owning class node (for self.method edges)."""
-    out = {}
-    for _, cnode in fctx.classes:
-        for child in cnode.body:
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                out[child] = cnode
-    return out
+    """Immediate method node -> owning class node (for self.method edges).
+    Memoized on the file context — shared by every call-graph consumer."""
+    cached = getattr(fctx, "_method_classes", None)
+    if cached is None:
+        cached = {}
+        for _, cnode in fctx.classes:
+            for child in cnode.body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cached[child] = cnode
+        fctx._method_classes = cached
+    return cached
+
+
+def scope_nodes(fctx, fn_node) -> list:
+    """The ``walk_scope`` node list of one function, parsed ONCE per run and
+    cached on the file context. Every checker that inspects function bodies
+    (blocking-async, compile-on-hot-path, the concurrency family, the call
+    graph itself) shares this list instead of re-walking the tree."""
+    cache = getattr(fctx, "_scope_nodes", None)
+    if cache is None:
+        cache = fctx._scope_nodes = {}
+    nodes = cache.get(fn_node)
+    if nodes is None:
+        nodes = cache[fn_node] = list(walk_scope(fn_node))
+    return nodes
 
 
 def call_edges(fctx, fn, fn_class: dict, module_of: dict) -> list:
@@ -81,7 +104,7 @@ def call_edges(fctx, fn, fn_class: dict, module_of: dict) -> list:
     compile-on-hot-path checkers. Callables merely REFERENCED (e.g. handed
     to run_in_executor) are not calls and produce no edge."""
     out = []
-    for node in walk_scope(fn):
+    for node in scope_nodes(fctx, fn):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -126,6 +149,145 @@ def call_edges(fctx, fn, fn_class: dict, module_of: dict) -> list:
                                 (target_fctx.relpath, target_fctx.qualname_of[t]),
                                 f"`{ast.unparse(func)}()`"))
     return out
+
+
+class CallGraph:
+    """Project-wide call-graph facts, computed ONCE per analysis run and
+    shared by every reachability checker (blocking-async,
+    compile-on-hot-path, the whole concurrency family). Before this cache
+    each of those checkers re-derived the same edges from a fresh AST walk
+    per checker; now the tree is walked once and the derived facts ride
+    along on the :class:`ProjectContext`.
+
+    ``edges``: (relpath, qualname) -> [(call_line, callee_key, label)]
+    ``async_keys``: keys of every ``async def`` in the project
+    ``functions``: key -> (fctx, fn_node) for direct body inspection
+    """
+
+    __slots__ = ("module_of", "edges", "async_keys", "functions")
+
+    def __init__(self, project: "ProjectContext"):
+        self.module_of = module_map(project)
+        self.edges: dict = {}
+        self.async_keys: set = set()
+        self.functions: dict = {}
+        for fctx in project.files:
+            fn_class = method_classes(fctx)
+            for qual, fn in fctx.functions:
+                key = (fctx.relpath, qual)
+                if isinstance(fn, ast.AsyncFunctionDef):
+                    self.async_keys.add(key)
+                self.functions[key] = (fctx, fn)
+                self.edges[key] = call_edges(fctx, fn, fn_class, self.module_of)
+        self._add_attr_typed_edges(project)
+
+    def _add_attr_typed_edges(self, project: "ProjectContext") -> None:
+        """``self.X.method()`` edges where ``self.X`` has exactly one
+        class-typed assignment (``self.X = SomeProjectClass(...)``) anywhere
+        in the owning class. This is how a store's public method reaches its
+        helper object's internals (the PR-9 spin lived in
+        ``_IdIndex._probe``, reached via ``self._ids.lookup()`` under the
+        store lock) — without these edges every composed-helper call is a
+        blind spot for all reachability checkers."""
+        # class name -> (fctx, cqual, cnode), per file (last definition wins)
+        local_classes: dict = {}
+        for fctx in project.files:
+            local_classes[fctx.relpath] = {
+                cqual.rsplit(".", 1)[-1]: (fctx, cqual, cnode)
+                for cqual, cnode in fctx.classes
+            }
+
+        def resolve_class(fctx, ctor_node):
+            resolved = fctx.resolve(ctor_node)
+            if not resolved:
+                return None
+            if "." not in resolved:
+                return local_classes.get(fctx.relpath, {}).get(resolved)
+            mod, _, name = resolved.rpartition(".")
+            target_fctx = self.module_of.get(mod)
+            if target_fctx is None:
+                return None
+            return local_classes.get(target_fctx.relpath, {}).get(name)
+
+        for fctx in project.files:
+            fn_class = method_classes(fctx)
+            # per class: attr -> target class, None when ambiguous
+            attr_types: dict = {}
+            for fn, cnode in fn_class.items():
+                types = attr_types.setdefault(id(cnode), {})
+                for node in scope_nodes(fctx, fn):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    target = resolve_class(fctx, node.value.func)
+                    if target is None:
+                        continue
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            prev = types.get(t.attr)
+                            if prev is not None and prev != target:
+                                types[t.attr] = None  # ambiguous: no edges
+                            elif prev is None and t.attr not in types:
+                                types[t.attr] = target
+            for fn, cnode in fn_class.items():
+                types = attr_types.get(id(cnode), {})
+                if not types:
+                    continue
+                key = (fctx.relpath, fctx.qualname_of[fn])
+                for node in scope_nodes(fctx, fn):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Attribute)
+                        and isinstance(node.func.value.value, ast.Name)
+                        and node.func.value.value.id == "self"
+                    ):
+                        continue
+                    target = types.get(node.func.value.attr)
+                    if target is None:
+                        continue
+                    tfctx, tcqual, tcnode = target
+                    for child in tcnode.body:
+                        if (
+                            isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and child.name == node.func.attr
+                        ):
+                            self.edges[key].append((
+                                node.lineno,
+                                (tfctx.relpath, f"{tcqual}.{child.name}"),
+                                f"`self.{node.func.value.attr}."
+                                f"{node.func.attr}()`",
+                            ))
+                            break
+
+    def propagate(self, facts: dict, edges: "dict | None" = None) -> dict:
+        """Fixpoint closure of per-function facts over the call graph: a
+        function whose callee carries a fact inherits (line, "label ->
+        cause") at the first such call site. ``facts`` maps key ->
+        (line, cause) for functions with a DIRECT fact; returns the
+        transitive map (callees' facts flowing up through callers).
+        ``edges`` substitutes a filtered edge map (hotcompile drops edges
+        into the warmup subsystem; the concurrency pass drops edges to
+        async/generator callees) — one closure algorithm, every caller."""
+        edge_map = self.edges if edges is None else edges
+        out = dict(facts)
+        changed = True
+        while changed:
+            changed = False
+            for key, outs in edge_map.items():
+                if key in out:
+                    continue
+                for line, callee, label in outs:
+                    if callee in out:
+                        _, cause = out[callee]
+                        out[key] = (line, f"{label} -> {cause}")
+                        changed = True
+                        break
+        return out
 
 
 @dataclasses.dataclass
@@ -429,6 +591,14 @@ class ProjectContext:
         self.files: list[FileContext] = files
         self.by_relpath = {f.relpath: f for f in files}
         self._reference_conf_text = reference_conf_text
+        self._call_graph: "CallGraph | None" = None
+
+    def call_graph(self) -> CallGraph:
+        """The shared project call graph, built on first use and reused by
+        every checker in the run."""
+        if self._call_graph is None:
+            self._call_graph = CallGraph(self)
+        return self._call_graph
 
     def reference_conf_text(self) -> str:
         if self._reference_conf_text is not None:
@@ -607,7 +777,13 @@ def analyze_project(
     baseline_path: "str | None" = None,
     checkers: "Iterable[str] | None" = None,
     reference_conf_text: "str | None" = None,
+    only_relpaths: "set | None" = None,
 ) -> AnalysisResult:
+    """Analyze ``paths``. ``only_relpaths`` scopes the REPORT to those
+    repo-relative files (``analyze --changed``): the whole project is still
+    parsed and the call graph still spans every file — cross-file
+    reachability must not shrink with the diff — only findings (and stale-
+    suppression hygiene) outside the set are dropped."""
     from oryx_tpu.tools.analyze.checkers import ALL_CHECKERS
 
     project, errors = build_project(paths, root, reference_conf_text)
@@ -617,11 +793,19 @@ def analyze_project(
         if wanted is not None and checker.id not in wanted:
             continue
         findings.extend(checker.check(project))
+    if only_relpaths is not None:
+        findings = [f for f in findings if f.path in only_relpaths]
     findings.sort(key=lambda f: (f.path, f.line, f.checker))
     baseline = load_baseline(baseline_path) if baseline_path else {}
     findings.extend(_apply_suppressions(project, findings, baseline))
-    if wanted is None:  # partial checker runs would false-flag stale
+    if wanted is None and only_relpaths is None:
+        # partial runs (by checker or by diff) would false-flag stale
         findings.extend(_unused_suppressions(project))
+    elif wanted is None:
+        findings.extend(
+            f for f in _unused_suppressions(project)
+            if f.path in only_relpaths
+        )
     return AnalysisResult(findings, errors)
 
 

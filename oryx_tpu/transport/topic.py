@@ -756,6 +756,8 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         self._buffer: list[tuple[KeyMessage, int, int]] = []
         self._processed: dict[int, int] = {}
         self._closed = threading.Event()
+        # last assignment actually used (rebalance-hysteresis baseline)
+        self._last_assigned: "list[int] | None" = None
 
     # -- partition assignment -------------------------------------------------
     def _assigned(self) -> list[int]:
@@ -764,10 +766,28 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             if now - self._last_heartbeat >= self._HEARTBEAT_SEC:
                 self._broker.join_group(self._group, self._topic, self._member_id)
                 self._last_heartbeat = now
-            members = self._broker.group_members(self._group, self._topic)
-            assigned = partitions_for_member(self._member_id, members, self._n_parts)
-            if self._partitions is not None:
-                assigned = [p for p in assigned if p in self._partitions]
+            assigned = self._assignment_from_view()
+            if (
+                self._last_assigned is not None
+                and set(assigned) - set(self._last_assigned)
+                and not self._closed.is_set()
+            ):
+                # rebalance hysteresis (ISSUE 11): GROWING the assignment on
+                # a single membership read is how a transient view (a
+                # heartbeat racing the TTL sweep, a blipped members RPC)
+                # turns into duplicate consumption — this member would claim
+                # partitions a live peer is still draining, and in earliest
+                # mode replay them from 0. Expansion must survive a second
+                # read one beat later; shrinking (a peer JOINED) stays
+                # immediate so two growers cannot overlap. Genuine takeover
+                # of a dead member's partitions just lands ~50 ms later.
+                self._closed.wait(0.05)
+                confirm = self._assignment_from_view()
+                if set(confirm) - set(self._last_assigned):
+                    assigned = confirm
+                else:
+                    assigned = [p for p in assigned if p in set(confirm)]
+            self._last_assigned = assigned
             # rebalance hygiene: a partition lost to another member leaves
             # no residue — a stale _processed entry would let this member's
             # commit loop clobber the new owner's (higher) committed offset,
@@ -782,6 +802,15 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
         if self._partitions is not None:
             return list(self._partitions)
         return list(range(self._n_parts))
+
+    def _assignment_from_view(self) -> list[int]:
+        """One membership read -> this member's partition list (static
+        ``partitions=`` filter applied)."""
+        members = self._broker.group_members(self._group, self._topic)
+        assigned = partitions_for_member(self._member_id, members, self._n_parts)
+        if self._partitions is not None:
+            assigned = [p for p in assigned if p in self._partitions]
+        return assigned
 
     def _offset_of(self, partition: int) -> int:
         off = self._offsets.get(partition)

@@ -7,6 +7,25 @@ CPU devices via --xla_force_host_platform_device_count.
 
 import os
 
+# Concurrency sanitizer (ISSUE 11): tier-1 runs the whole suite sanitized —
+# every e2e/chaos/fleet test doubles as a race harness. Default ON under
+# pytest (ORYX_SANITIZE=off opts out); installed HERE, before jax/oryx
+# imports allocate any locks, so repo locks are wrapped from the start.
+# Subprocess tests (fleet replicas, cli broker) inherit the env var and
+# self-install via oryx_tpu/__init__. The session gate below fails the run
+# on any lock-order cycle or loop-stall report (docs/sanitizer.md).
+os.environ.setdefault("ORYX_SANITIZE", "locks,loop")
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from oryx_tpu.tools import sanitize  # noqa: E402
+
+sanitize.install_from_env()
+# the session gate keys off the state at startup: a unit test force-
+# installing a mode mid-run must not arm the gate for an opted-out session
+_SANITIZE_AT_START = sanitize.enabled()
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -21,6 +40,48 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "no_sanitize: suspend the concurrency sanitizer for this test "
+        "(perf-floor tests — bookkeeping must not skew measured floors)",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_scope(request):
+    """``@pytest.mark.no_sanitize`` suspends all sanitizer bookkeeping for
+    the test body (one int read per lock op while suspended)."""
+    if request.node.get_closest_marker("no_sanitize"):
+        with sanitize.suspended():
+            yield
+    else:
+        yield
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The tier-1 sanitizer gate: zero lock-order cycles and zero
+    loop-stall reports across the whole sanitized suite. Long-hold
+    outliers are printed as information but do not gate (they are tuning
+    signals, not soundness violations)."""
+    if not _SANITIZE_AT_START:
+        return
+    rep = sanitize.report()
+    failing = rep["lock_cycles"] or rep["loop_stalls"]
+    if failing or rep["long_holds"]:
+        print("\n" + sanitize.render_report(rep))
+    if failing:
+        print(
+            "SANITIZER GATE FAILED: "
+            f"{len(rep['lock_cycles'])} lock-order cycle(s), "
+            f"{len(rep['loop_stalls'])} loop stall(s)"
+        )
+        session.exitstatus = 3
 
 
 @pytest.fixture(autouse=True)

@@ -98,7 +98,14 @@ def test_batch_layer_end_to_end(tmp_path):
         b = tp.get_broker("memory:")
         updates = b.read("OryxUpdate", 0)
         assert [km.key for km in updates][:2] == ["MODEL", "MODEL"]
-        # data persisted as segments
+        # data persisted as segments — the update callback fires BEFORE the
+        # generation's segment write (_on_generation step 1 vs step 2), so
+        # the second segment may land a beat after the recorded call;
+        # bounded wait, same assertion
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and len(list(layer.data_store.segments())) < 2):
+            time.sleep(0.05)
         assert len(list(layer.data_store.segments())) == 2
     finally:
         layer.close()

@@ -1,0 +1,398 @@
+"""Runtime concurrency sanitizer (oryx_tpu/tools/sanitize): cycle detector,
+long-hold outliers, loop-stall watchdog, suspension, and the env/config
+surface.
+
+Every test that seeds a deadlock- or stall-shaped workload runs inside
+``sanitize.isolated()`` — a fresh lock graph + stall watch swapped in for
+the duration — so the deliberate violations can never reach the session
+gate in conftest (which fails tier-1 on any cycle or stall).
+
+The deadlock-shaped threads acquire in BOTH orders sequentially, never
+concurrently: the point of an order sanitizer is exactly that it sees the
+hazard without the interleaving that hangs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.tools import sanitize
+from oryx_tpu.tools.sanitize import locks as san_locks
+from oryx_tpu.tools.sanitize import loop as san_loop
+
+
+# ---------------------------------------------------------------------------
+# LockGraph unit tests (driven directly — no patching involved)
+# ---------------------------------------------------------------------------
+
+
+def _run_in_thread(fn) -> None:
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_cycle_detector_flags_inverted_order():
+    g = sanitize.LockGraph()
+
+    def t1():
+        g.on_acquired("a.py:1", obj="A")
+        g.on_acquired("b.py:2", obj="B")
+        g.on_released("b.py:2", obj="B")
+        g.on_released("a.py:1", obj="A")
+
+    def t2():
+        g.on_acquired("b.py:2", obj="B")
+        g.on_acquired("a.py:1", obj="A")
+        g.on_released("a.py:1", obj="A")
+        g.on_released("b.py:2", obj="B")
+
+    _run_in_thread(t1)
+    _run_in_thread(t2)
+    cycles = g.cycles()
+    assert len(cycles) == 1
+    ring = cycles[0]["ring"]
+    assert set(ring) == {"a.py:1", "b.py:2"}
+    # both edges carry their first-seen acquisition stack
+    assert len(cycles[0]["edges"]) == 2
+    assert all(e["stack"] for e in cycles[0]["edges"])
+
+
+def test_cycle_detector_quiet_on_consistent_order_and_same_site():
+    g = sanitize.LockGraph()
+
+    def t1():
+        g.on_acquired("a.py:1", obj="A")
+        g.on_acquired("b.py:2", obj="B")
+        g.on_released("b.py:2", obj="B")
+        g.on_released("a.py:1", obj="A")
+
+    def t2():
+        # same order again, plus same-site nesting (two instances from one
+        # allocation line) — neither may produce a cycle
+        g.on_acquired("a.py:1", obj="A")
+        g.on_acquired("a.py:1", obj="A2")
+        g.on_acquired("b.py:2", obj="B")
+        g.on_released("b.py:2", obj="B")
+        g.on_released("a.py:1", obj="A2")
+        g.on_released("a.py:1", obj="A")
+
+    _run_in_thread(t1)
+    _run_in_thread(t2)
+    assert g.cycles() == []
+    assert ("a.py:1", "a.py:1") not in g.edges()
+
+
+def test_cycle_detector_finds_three_lock_ring():
+    g = sanitize.LockGraph()
+    order = [("a", "b"), ("b", "c"), ("c", "a")]
+
+    for first, second in order:
+        def nest(first=first, second=second):
+            g.on_acquired(first, obj=first + "1")
+            g.on_acquired(second, obj=second + "1")
+            g.on_released(second, obj=second + "1")
+            g.on_released(first, obj=first + "1")
+
+        _run_in_thread(nest)
+    cycles = g.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]["ring"]) == {"a", "b", "c"}
+
+
+def test_long_hold_outlier_reported_with_duration():
+    g = sanitize.LockGraph(long_hold_ms=10.0)
+
+    def hold():
+        g.on_acquired("slow.py:9", obj="L")
+        time.sleep(0.05)
+        g.on_released("slow.py:9", obj="L")
+
+    _run_in_thread(hold)
+    holds = g.long_holds()
+    assert len(holds) == 1
+    assert holds[0]["site"] == "slow.py:9"
+    assert holds[0]["held_ms"] >= 10.0
+
+
+# ---------------------------------------------------------------------------
+# Installed-wrapper integration (deliberately deadlock-shaped threads)
+# ---------------------------------------------------------------------------
+
+
+def test_installed_wrappers_catch_deadlock_shaped_threads():
+    sanitize.install({"locks"})
+    with sanitize.isolated() as (graph, _watch):
+        lock_a = threading.Lock()   # wrapped: allocated from a tests/ frame
+        lock_b = threading.Lock()
+        assert type(lock_a).__name__ == "SanLock"
+
+        def forward():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def backward():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        _run_in_thread(forward)
+        _run_in_thread(backward)
+        cycles = graph.cycles()
+        assert len(cycles) == 1
+        assert all("test_sanitize.py" in site for site in cycles[0]["ring"][:2])
+        report = sanitize.render_report(sanitize.report())
+        assert "LOCK-ORDER CYCLE" in report
+    # the swapped-out session graph never saw the seeded cycle
+    assert sanitize.lock_graph() is not graph
+
+
+def test_condition_on_sanitized_rlock_keeps_working():
+    """threading.Condition() built while the sanitizer is installed rides a
+    wrapped RLock; wait/notify must work, and wait() must RELEASE the lock
+    in the held model (the bookkeeping survives _release_save /
+    _acquire_restore round trips without corrupting the held stack)."""
+    sanitize.install({"locks"})
+    with sanitize.isolated() as (graph, _watch):
+        cond = threading.Condition()
+        ready = []
+
+        def waiter():
+            with cond:
+                ready.append("waiting")
+                ok = cond.wait(timeout=5)
+                ready.append(ok)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        for _ in range(500):
+            if ready:
+                break
+            time.sleep(0.01)
+        with cond:
+            cond.notify_all()
+        t.join(10)
+        assert ready == ["waiting", True]
+        assert graph.cycles() == []
+
+
+def test_suspended_records_no_bookkeeping():
+    sanitize.install({"locks"})
+    with sanitize.isolated() as (graph, _watch):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def both_orders():
+            with sanitize.suspended():
+                with lock_a:
+                    with lock_b:
+                        pass
+                with lock_b:
+                    with lock_a:
+                        pass
+
+        _run_in_thread(both_orders)
+        assert graph.edges() == {}
+        assert graph.cycles() == []
+
+
+def test_release_inside_suspended_window_leaves_no_ghost_hold():
+    """Regression: suspension is process-global, so a lock ACQUIRED with
+    recording on and RELEASED inside a suspended window (another test's
+    no_sanitize body, with this thread still running) must still pop from
+    the held stack — a ghost entry would edge into every later acquisition
+    on the thread and manufacture phantom cycles (exactly what the first
+    full suite run produced between two Thread-startup Event locks)."""
+    sanitize.install({"locks"})
+    with sanitize.isolated() as (graph, _watch):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        lock_c = threading.Lock()
+
+        def ghost_shape():
+            lock_a.acquire()
+            with sanitize.suspended():
+                lock_a.release()      # must still pop the held entry
+            with lock_b:              # were lock_a a ghost, b and c would
+                with lock_c:          # both edge from its site
+                    pass
+
+        _run_in_thread(ghost_shape)
+        edges = graph.edges()
+        a_site = lock_a._site
+        assert not any(src == a_site for src, _ in edges)
+        assert any(dst == lock_c._site for _, dst in edges)  # real nesting seen
+
+
+# ---------------------------------------------------------------------------
+# Loop-stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_stall_watch_records_completed_stall():
+    w = sanitize.StallWatch(stall_ms=20.0)
+    token = w.enter("<fixture callback>")
+    time.sleep(0.05)
+    w.exit(token, "<fixture callback>")
+    stalls = w.stalls()
+    assert len(stalls) == 1
+    assert stalls[0]["stalled_ms"] >= 20.0
+    assert stalls[0]["callback"] == "<fixture callback>"
+
+
+def test_stall_watchdog_captures_live_stack_of_blocked_thread():
+    """The watchdog samples a stall WHILE the thread is still blocked: the
+    report carries the live stack naming the blocking line (the thing
+    asyncio's own post-hoc slow-callback log cannot give)."""
+    w = sanitize.StallWatch(stall_ms=30.0)
+
+    def stall_shaped():
+        token = w.enter("<blocked callback>")
+        time.sleep(0.2)
+        w.exit(token, "<blocked callback>")
+
+    t = threading.Thread(target=stall_shaped)
+    t.start()
+    time.sleep(0.08)   # inside the blocked window
+    w.sample()
+    t.join(5)
+    stalls = w.stalls()
+    assert len(stalls) == 1
+    assert "time.sleep(0.2)" in stalls[0]["stack"]
+
+
+def test_loop_watchdog_end_to_end_on_blocked_asyncio_loop():
+    sanitize.install({"loop"})
+    with sanitize.isolated() as (_graph, watch):
+
+        async def main():
+            def blocks_the_loop():
+                time.sleep(0.4)
+
+            loop = asyncio.get_running_loop()
+            loop.call_soon(blocks_the_loop)
+            await asyncio.sleep(0.6)
+
+        asyncio.run(main())
+        stalls = watch.stalls()
+        assert len(stalls) == 1
+        assert stalls[0]["stalled_ms"] >= watch.stall_ms
+        assert "time.sleep" in stalls[0]["stack"]  # caught LIVE
+    assert sanitize.stall_watch() is not watch
+
+
+def test_stall_watch_honors_suspension_on_both_record_paths():
+    """A stall completing (or sampled) inside a suspended window must not
+    reach the gate — suspension is process-global, and a no_sanitize perf
+    test may legitimately starve background loops (review finding: the
+    loop side used to record unconditionally)."""
+    w = sanitize.StallWatch(stall_ms=10.0)
+    token = w.enter("<spans suspension>")
+    time.sleep(0.03)
+    with sanitize.suspended():
+        w.sample()                      # watchdog pass inside the window
+        w.exit(token, "<spans suspension>")   # completion inside the window
+    assert w.stalls() == []
+    # recording resumes the moment suspension lifts
+    token = w.enter("<after window>")
+    time.sleep(0.03)
+    w.exit(token, "<after window>")
+    assert len(w.stalls()) == 1
+
+
+def test_stall_watch_subtracts_gc_pause_time():
+    """A 'stall' that is mostly a cyclic-GC pass must not gate (an
+    environmental pause, not a code defect); a stall that stays over the
+    threshold after GC subtraction reports WITH its gc_ms annotated."""
+    w = sanitize.StallWatch(stall_ms=30.0)
+    t_end = time.monotonic()
+    t0 = t_end - 0.050  # a 50 ms callback window
+    try:
+        # GC covered 40 of the 50 ms: effective 10 ms < threshold -> silent
+        san_loop._GC_WINDOWS.append((t0 + 0.005, t0 + 0.045))
+        w._record(1, t0, "<gc heavy>", 50.0, "",
+                  gc_ms=san_loop._gc_overlap_ms(t0, t_end))
+        assert w.stalls() == []
+        # GC covered only 10 ms: effective 40 ms >= threshold -> reported
+        san_loop._GC_WINDOWS.clear()
+        san_loop._GC_WINDOWS.append((t0 + 0.005, t0 + 0.015))
+        w._record(2, t0, "<code heavy>", 50.0, "",
+                  gc_ms=san_loop._gc_overlap_ms(t0, t_end))
+        stalls = w.stalls()
+        assert len(stalls) == 1
+        assert 5.0 <= stalls[0]["gc_ms"] <= 15.0
+    finally:
+        san_loop._GC_WINDOWS.clear()
+
+
+def test_loop_watchdog_quiet_on_well_behaved_loop():
+    sanitize.install({"loop"})
+    with sanitize.isolated() as (_graph, watch):
+
+        async def main():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, time.sleep, 0.05)
+            await asyncio.sleep(0.01)
+
+        asyncio.run(main())
+        assert watch.stalls() == []
+
+
+# ---------------------------------------------------------------------------
+# env/config surface
+# ---------------------------------------------------------------------------
+
+
+def test_parse_modes():
+    assert sanitize.parse_modes("locks,loop") == {"locks", "loop"}
+    assert sanitize.parse_modes("locks") == {"locks"}
+    assert sanitize.parse_modes(" loop ") == {"loop"}
+    assert sanitize.parse_modes("off") == frozenset()
+    assert sanitize.parse_modes("0") == frozenset()
+    assert sanitize.parse_modes(None) == frozenset()
+    assert sanitize.parse_modes("bogus") == frozenset()
+
+
+def test_configure_applies_sanitize_thresholds(monkeypatch):
+    monkeypatch.delenv("ORYX_SANITIZE_LOOP_STALL_MS", raising=False)
+    monkeypatch.delenv("ORYX_SANITIZE_LONG_HOLD_MS", raising=False)
+    overlay = cfg.Config.parse_string(
+        "oryx = { sanitize = { loop-stall-ms = 111, long-hold-ms = 222 } }"
+    )
+    old_stall = san_loop._stall_ms
+    old_hold = san_locks.graph().long_hold_ms
+    try:
+        sanitize.configure(overlay.overlay_on(cfg.get_default()))
+        assert san_loop._stall_ms == 111.0
+        assert san_locks.graph().long_hold_ms == 222.0
+    finally:
+        san_loop.set_stall_ms(old_stall)
+        san_locks.graph().long_hold_ms = old_hold
+
+
+def test_reference_conf_declares_sanitize_defaults():
+    conf = cfg.get_default()
+    assert conf.get_float("oryx.sanitize.loop-stall-ms") == 250.0
+    assert conf.get_float("oryx.sanitize.long-hold-ms") == 250.0
+
+
+def test_report_is_clean_shape_when_nothing_found():
+    with sanitize.isolated():
+        rep = sanitize.report()
+        assert rep["lock_cycles"] == []
+        assert rep["loop_stalls"] == []
+        assert "clean" in sanitize.render_report(rep)
+
+
+@pytest.mark.no_sanitize
+def test_no_sanitize_marker_suspends_bookkeeping():
+    if not sanitize.enabled():
+        pytest.skip("sanitizer not installed in this session")
+    assert sanitize.is_suspended()

@@ -793,6 +793,513 @@ def test_per_row_store_quiet_on_arena_idiom_and_cold_paths():
 
 
 # ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_fires_on_inverted_nesting():
+    hits = _run(
+        """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._lock_a = threading.Lock()
+                self._lock_b = threading.Lock()
+
+            def forward(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        return 1
+
+            def backward(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        return 2
+        """,
+        "lock-order-cycle",
+    )
+    assert len(hits) == 1
+    msg = hits[0].message
+    assert "Pair._lock_a" in msg and "Pair._lock_b" in msg
+    assert "Path A" in msg and "Path B" in msg  # both acquisition paths
+
+
+def test_lock_order_cycle_fires_interprocedurally():
+    """The whole-program shape: the inversion is only visible when the
+    callee's acquisition set propagates through the call graph — `flush`
+    holds the journal lock and calls a helper that takes the store lock,
+    while `snapshot` nests them the other way around."""
+    hits = _run(
+        """
+        import threading
+
+        class Journal:
+            def __init__(self):
+                self._journal_lock = threading.Lock()
+                self._store_lock = threading.Lock()
+
+            def _persist(self):
+                with self._store_lock:
+                    return 1
+
+            def flush(self):
+                with self._journal_lock:
+                    return self._persist()
+
+            def snapshot(self):
+                with self._store_lock:
+                    with self._journal_lock:
+                        return 2
+        """,
+        "lock-order-cycle",
+    )
+    assert len(hits) == 1
+    assert "_persist" in hits[0].message  # the call path is in the finding
+
+
+def test_lock_order_cycle_quiet_on_consistent_order_and_reentry():
+    hits = _run(
+        """
+        import threading
+
+        class Consistent:
+            def __init__(self):
+                self._lock_a = threading.Lock()
+                self._lock_b = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def one(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        return 1
+
+            def two(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        return 2
+
+            def reenter(self):
+                with self._rlock:
+                    with self._rlock:   # RLock re-entry: never a cycle
+                        return 3
+        """,
+        "lock-order-cycle",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_under_lock_fires_on_sleep_await_and_executor():
+    hits = _run(
+        """
+        import asyncio
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            async def parked(self):
+                with self._lock:
+                    await asyncio.sleep(0.1)
+
+            async def hopped(self, loop):
+                with self._lock:
+                    fut = loop.run_in_executor(None, print)
+                return await fut
+        """,
+        "blocking-under-lock",
+    )
+    assert len(hits) == 3
+    assert any("time.sleep" in f.message for f in hits)
+    assert any("`await` parks" in f.message for f in hits)
+    assert any("run_in_executor" in f.message for f in hits)
+    assert all("S._lock" in f.message for f in hits)
+
+
+def test_blocking_under_lock_quiet_when_work_moves_outside():
+    hits = _run(
+        """
+        import asyncio
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def slow(self):
+                with self._lock:
+                    value = self._value
+                time.sleep(0.5)       # lock released first
+                return value
+
+            async def parked(self):
+                with self._lock:
+                    value = self._value
+                await asyncio.sleep(0.1)
+                return value
+
+            def probe(self):
+                with self._lock:
+                    while True:       # bounded: structural exits exist
+                        if self._value:
+                            return self._value
+        """,
+        "blocking-under-lock",
+    )
+    assert hits == []
+
+
+def test_blocking_under_lock_quiet_after_try_finally_release():
+    """The canonical acquire/try/finally pattern fully releases the lock:
+    the finally body's effects flow into the statements after the try, so
+    slow work there is NOT under the lock (review finding: the per-body
+    held-list copy used to swallow the release)."""
+    hits = _run(
+        """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def canonical(self):
+                self._lock.acquire()
+                try:
+                    self._n += 1
+                finally:
+                    self._lock.release()
+                time.sleep(0.5)     # lock already released
+
+            def still_caught(self):
+                self._lock.acquire()
+                try:
+                    time.sleep(0.5)  # inside the region: still a finding
+                finally:
+                    self._lock.release()
+        """,
+        "blocking-under-lock",
+    )
+    assert len(hits) == 1 and "still_caught" in hits[0].message
+
+
+def test_lock_order_cycle_quiet_on_async_callee_acquisitions():
+    """Calling an async def only builds a coroutine — its lock acquisitions
+    do not happen at the call site, so they must not be pulled into the
+    caller's held context (review finding: a phantom a->b edge used to
+    combine with the async body's real b->a into an impossible deadlock).
+    A lock held across the await is blocking-under-lock's job instead."""
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock_a = threading.Lock()
+                self._lock_b = threading.Lock()
+
+            def make(self):
+                with self._lock_a:
+                    return self.work()   # builds a coroutine, runs nothing
+
+            async def work(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        return 1
+        """
+    assert _run(src, "lock-order-cycle") == []
+
+
+def test_shared_state_escape_quiet_with_common_module_lock():
+    """A module-global lock guarding both sides is a common guard exactly
+    like a class lock (review finding: only class locks used to count)."""
+    hits = _run(
+        """
+        import threading
+
+        _mod_lock = threading.Lock()
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._drain)
+                self.backlog = []
+
+            def _drain(self):
+                with _mod_lock:
+                    self.backlog = []
+
+            async def enqueue(self, item):
+                with _mod_lock:
+                    self.backlog = [item]
+        """,
+        "shared-state-escape",
+    )
+    assert hits == []
+
+
+def test_blocking_under_lock_catches_pr9_tombstone_spin_shape():
+    """Regression pin for the PR-9 arena bug class: `get_vector` held the
+    store lock while `_IdIndex._probe` spun forever (tombstones had
+    exhausted the probe table's empty slots). The checker must see the spin
+    THROUGH the attr-typed call (`self._ids` is a project class), i.e. the
+    cross-class whole-program path, not just a literal loop under `with`."""
+    hits = _run(
+        """
+        import threading
+
+        class _IdIndex:
+            def __init__(self):
+                self._table = [0] * 8
+
+            def _probe(self, h):
+                slot = h & 7
+                while True:
+                    row = int(self._table[slot])
+                    slot = (slot + 1) & 7
+
+            def lookup(self, id_):
+                return self._probe(hash(id_))
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ids = _IdIndex()
+
+            def get_vector(self, id_):
+                with self._lock:
+                    return self._ids.lookup(id_)
+        """,
+        "blocking-under-lock",
+    )
+    assert len(hits) == 1
+    f = hits[0]
+    assert "Store._lock" in f.message and "spin forever" in f.message
+    assert "lookup" in f.message  # the call path into the helper class
+
+
+def test_blocking_under_lock_quiet_on_generator_loops():
+    """A `while True: yield` loop suspends every iteration — neither the
+    generator body under a lock nor a caller holding a lock around the
+    generator CALL (which only builds the object) is a spin (review
+    finding: the phantom-execution class, same rule as async callees)."""
+    src = """
+        import threading
+
+        class Ticker:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ticks(self):
+                while True:
+                    yield 1
+
+            def start(self):
+                with self._lock:
+                    return self.ticks()   # builds a generator, runs nothing
+        """
+    assert _run(src, "blocking-under-lock") == []
+
+
+def test_cli_changed_rejects_update_baseline_and_emits_json(monkeypatch, capsys):
+    """--changed guards: combined with --update-baseline it must refuse (a
+    scoped write_baseline would truncate other files' accepted entries),
+    and with --format json an empty diff still emits a parseable JSON
+    document (CI pipes into jq)."""
+    from oryx_tpu.tools.analyze import cli as analyze_cli
+
+    assert analyze_cli.main(["--changed", "--update-baseline"]) == 2
+    capsys.readouterr()
+
+    monkeypatch.setattr(analyze_cli, "_changed_relpaths", lambda root: set())
+    assert analyze_cli.main(["--changed", "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["unsuppressed"] == 0 and data["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# shared-state-escape
+# ---------------------------------------------------------------------------
+
+
+def test_shared_state_escape_fires_on_cross_context_writes():
+    hits = _run(
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._drain)
+                self.backlog = []
+
+            def _drain(self):
+                self.backlog = []          # thread context
+
+            async def enqueue(self, item):
+                self.backlog = [item]      # event-loop context
+        """,
+        "shared-state-escape",
+    )
+    assert len(hits) == 1
+    assert hits[0].symbol == "Pump.backlog"
+    assert "_drain" in hits[0].message and "enqueue" in hits[0].message
+
+
+def test_shared_state_escape_fires_on_thread_subclass_run():
+    hits = _run(
+        """
+        import threading
+
+        class Warmer(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self.progress = 0
+
+            def run(self):
+                self.progress = 1          # the Thread's own context
+
+            async def status(self):
+                self.progress = 2          # loop context, unguarded
+        """,
+        "shared-state-escape",
+    )
+    assert len(hits) == 1 and hits[0].symbol == "Warmer.progress"
+
+
+def test_shared_state_escape_quiet_with_common_lock_or_one_context():
+    hits = _run(
+        """
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._drain)
+                self.backlog = []
+                self.loop_only = 0
+
+            def _drain(self):
+                with self._lock:
+                    self.backlog = []
+
+            async def enqueue(self, item):
+                with self._lock:
+                    self.backlog = [item]
+                self.loop_only = 1        # written from ONE context only
+
+            async def peek(self):
+                self.loop_only = 2        # still only loop context
+        """,
+        "shared-state-escape",
+    )
+    assert hits == []
+
+
+# ---------------------------------------------------------------------------
+# shared call graph + --changed scoping (analyze runtime satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_call_graph_is_built_once_and_shared():
+    from oryx_tpu.tools.analyze.core import build_project
+
+    project, errors = build_project(
+        [os.path.join(REPO_ROOT, "oryx_tpu", "tools", "analyze")],
+        root=REPO_ROOT,
+    )
+    assert errors == []
+    g1 = project.call_graph()
+    g2 = project.call_graph()
+    assert g1 is g2  # memoized: one AST walk feeds every checker
+    assert g1.edges and g1.functions
+
+
+def test_attr_typed_call_edges_resolve_helper_classes():
+    """`self._ids.lookup()` resolves to the helper class's method when the
+    attribute has exactly one class-typed assignment — the edge that makes
+    the PR-9 shape visible to every reachability checker."""
+    import textwrap as _tw
+
+    from oryx_tpu.tools.analyze.core import FileContext, ProjectContext
+
+    src = _tw.dedent(
+        """
+        class Helper:
+            def work(self):
+                return 1
+
+        class Owner:
+            def __init__(self):
+                self._h = Helper()
+
+            def call(self):
+                return self._h.work()
+        """
+    )
+    project = ProjectContext([FileContext("m.py", "m.py", src)])
+    edges = project.call_graph().edges[("m.py", "Owner.call")]
+    assert any(callee == ("m.py", "Helper.work") for _, callee, _ in edges)
+
+
+def test_analyze_changed_scopes_report_but_keeps_cross_file_reachability():
+    """--changed semantics (core level): findings outside the changed set
+    are dropped, but a changed async handler still gets flagged through its
+    call into an UNCHANGED helper — the call graph must span the whole
+    project regardless of the diff."""
+    from oryx_tpu.tools.analyze.core import FileContext, ProjectContext, analyze_project
+    import tempfile
+
+    handler = textwrap.dedent(
+        """
+        from helper import send_line
+
+        async def ingest(request, producer):
+            send_line(producer, "x")
+        """
+    )
+    helper = textwrap.dedent(
+        """
+        import time
+
+        def send_line(producer, line):
+            time.sleep(0.1)
+
+        async def also_bad(request):
+            time.sleep(0.1)
+        """
+    )
+    with tempfile.TemporaryDirectory() as d:
+        for name, src in (("handler.py", handler), ("helper.py", helper)):
+            with open(os.path.join(d, name), "w", encoding="utf-8") as fh:
+                fh.write(src)
+        scoped = analyze_project(
+            [d], root=d, checkers=["blocking-async"],
+            only_relpaths={"handler.py"},
+        )
+        # the changed handler IS flagged (through the unchanged helper)...
+        assert any(
+            f.path == "handler.py" and "send_line" in f.message
+            for f in scoped.findings
+        )
+        # ...and the unchanged helper's own finding is scoped out
+        assert not any(f.path == "helper.py" for f in scoped.findings)
+        full = analyze_project([d], root=d, checkers=["blocking-async"])
+        assert any(f.path == "helper.py" for f in full.findings)
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

@@ -568,6 +568,39 @@ def test_group_rebalance_on_leave(broker_url):
     it1.close()
 
 
+def test_assignment_expansion_needs_a_stable_view(monkeypatch):
+    """Rebalance hysteresis (ISSUE 11): a consumer must not GROW its
+    partition set on a single membership read — a transient view missing a
+    live peer (a heartbeat racing the TTL sweep, a blipped RPC) would make
+    it claim partitions the peer is still draining and, in earliest mode,
+    replay them from offset 0 (duplicate consumption). Expansion must
+    survive a second read one beat later; a genuine takeover still lands."""
+    broker = _partitioned_broker("memory:")
+    it1 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="c1")
+    it2 = tp.ConsumeDataIterator(broker, "P", "earliest", group="g", member_id="c2")
+    assert it1._assigned() == [0, 2]  # steady state
+
+    real = broker.group_members
+    calls = {"n": 0}
+
+    def one_bad_view(group, topic):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return ["c1"]  # transient: c2 missing for exactly one read
+        return real(group, topic)
+
+    monkeypatch.setattr(broker, "group_members", one_bad_view)
+    # the blip is rejected: the confirming read still shows c2, so the
+    # assignment stays put instead of expanding over c2's partitions
+    assert it1._assigned() == [0, 2]
+    assert calls["n"] >= 2  # a confirming read actually happened
+
+    # a REAL takeover (c2 leaves; absent on BOTH reads) lands normally
+    it2.close()
+    assert it1._assigned() == [0, 1, 2, 3]
+    it1.close()
+
+
 _REBALANCE_CONSUMER = """
 import json, sys
 from oryx_tpu.transport import topic as tp
