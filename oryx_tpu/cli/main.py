@@ -140,6 +140,9 @@ def cmd_broker(argv: "list[str]") -> int:
     from oryx_tpu.transport import netbroker
 
     netbroker.configure(config)
+    # the server's inner FileBroker honors oryx.broker.file.* (fsync
+    # durability policy, torn-tail recovery) exactly like a local file:
+    tp.configure(config)
     server_cfg = config.get_config("oryx.broker.tcp.server")
     host = args.host or server_cfg.get_string("host", "0.0.0.0")
     stats_interval = server_cfg.get_float("stats-interval-sec", 60.0)
@@ -224,6 +227,7 @@ def main(argv: "list[str] | None" = None) -> int:
     from oryx_tpu.transport import netbroker
 
     netbroker.configure(config)
+    tp.configure(config)
     if args.command == "batch":
         return _run_layer("oryx_tpu.lambda_rt.batch.BatchLayer", config)
     if args.command == "speed":

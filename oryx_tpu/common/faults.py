@@ -23,8 +23,12 @@ programmatic (:func:`arm`) for tests. The spec grammar is
 Injected failures raise :class:`InjectedFault` (an ``OSError`` subclass, so
 the transport retry predicate classifies them as transient — exactly like
 the real faults they stand in for). Known sites: ``broker.append``,
-``broker.read``, ``broker.offset``, ``serving.update_consume``,
-``serving.device_call`` (docs/robustness.md has the cookbook).
+``broker.read``, ``broker.offset``, ``broker.fsync`` (fails/delays the
+file broker's durability fsync — appends survive, durability degrades),
+``ckpt.save`` / ``ckpt.load`` (fails trainer checkpoint writes/restores —
+training must complete anyway, common/checkpoint.py),
+``serving.update_consume``, ``serving.device_call`` (docs/robustness.md
+has the cookbook).
 """
 
 from __future__ import annotations

@@ -55,6 +55,22 @@ oryx = {
   # process-wide by netbroker.configure, the resilience idiom) and the
   # server process.
   broker = {
+    # Durability policy for the file: broker's append log (adopted
+    # process-wide by transport/topic.configure; the tcp: broker server's
+    # inner FileBroker honors it too — docs/robustness.md "Durability").
+    file = {
+      # When the log fsyncs after an append:
+      #   "never"    - page cache only (process kill -9 safe; power loss
+      #                can drop the un-synced suffix — torn-tail recovery
+      #                truncates it cleanly at next open)
+      #   "interval" - at most one fsync per fsync-interval-ms per
+      #                partition (bounds power-loss exposure at the
+      #                interval; ~zero per-append cost)
+      #   "always"   - fsync every append (Kafka flush.messages=1
+      #                equivalent; the slowest, strongest setting)
+      fsync = "never"
+      fsync-interval-ms = 100
+    }
     tcp = {
       # TCP connect budget for a client's first (or reconnect) dial.
       connect-timeout-sec = 10
@@ -94,6 +110,24 @@ oryx = {
       config = ${oryx.default-compute-config}
     }
     update-class = null
+    # Preemption-tolerant trainer checkpoints (common/checkpoint.py): the
+    # ALS trainer saves factor state every interval-iterations into an
+    # atomic, checksummed store, and a restarted generation whose data
+    # fingerprint (input offsets + hyperparams + shapes) matches resumes
+    # from the newest valid checkpoint — a kill -9 mid-training redoes at
+    # most one interval instead of the whole generation
+    # (docs/robustness.md "Durability").
+    checkpoint = {
+      enabled = false
+      # Directory for checkpoint files; null disables even when enabled.
+      dir = null
+      # Save cadence in completed ALS iterations (the final iteration is
+      # always saved so a crash before publish resumes for free).
+      interval-iterations = 5
+      # Checkpoints retained per data fingerprint; the directory is
+      # additionally capped at 4x this across superseded generations.
+      keep = 2
+    }
     storage = {
       data-dir = "/tmp/OryxTPU/data/"
       model-dir = "/tmp/OryxTPU/model/"

@@ -70,10 +70,16 @@ class BatchLayer(AbstractLayer):
             _GENERATION_ITEMS.inc(len(new_data))
             # 1. user update with past data + sync model producer
             past_data = list(self.data_store.read_all())
+            context = self.get_context()
+            # data identity for preemption-tolerant checkpoints: the input
+            # positions this generation read through (checkpoint.fingerprint
+            # folds them in, so a restarted generation — same uncommitted
+            # offsets, same slice — resumes its own state and nothing else)
+            context.input_offsets = self.current_input_offsets
             producer = TopicProducerImpl(self.update_broker, self.update_topic)
             try:
                 self._update_instance.run_update(
-                    self.get_context(),
+                    context,
                     timestamp_ms,
                     new_data,
                     past_data,

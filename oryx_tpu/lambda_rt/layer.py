@@ -64,6 +64,7 @@ class AbstractLayer:
         resilience.configure(config)
         faults.configure(config)
         netbroker.configure(config)  # tcp:// client timeouts/frame caps
+        tp.configure(config)  # file-broker fsync durability policy
         # trainer cost accounting + memory gauges report through the same
         # /metrics surface as serving replicas (scraped or snapshotted by
         # bench_batch) — peaks and gauges configure here too
@@ -109,6 +110,12 @@ class AbstractLayer:
         gen_policy.max_elapsed_sec = float("inf")
         self._generation_policy = gen_policy
         self._group = f"OryxGroup-{tier}-{self.id}" if self.id else None
+        # per-partition input positions AFTER reading the current
+        # generation's slice — the data-identity half of a trainer
+        # checkpoint's fingerprint. Stable across a crash-restart: offsets
+        # are only committed after a generation completes, so a re-run
+        # generation reads the same slice and lands on the same values.
+        self.current_input_offsets: "dict[int, int] | None" = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._failure: BaseException | None = None
@@ -237,6 +244,7 @@ class AbstractLayer:
                 )
                 continue
             offsets = new_offsets
+            self.current_input_offsets = dict(offsets)
             if n_corrupt:
                 # one rate-limited (per-generation) line, not one per record:
                 # a corrupted log segment would otherwise flood the logger

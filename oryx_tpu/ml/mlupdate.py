@@ -92,6 +92,19 @@ class MLUpdate(BatchLayerUpdate):
     ) -> None:
         """Hook (MLUpdate.java:139-146); default no-op."""
 
+    def make_checkpointer(self, fp: str, meta: "dict | None" = None):
+        """``oryx.batch.checkpoint.*`` → a ``TrainerCheckpointer`` keyed by
+        the candidate's data fingerprint, or None when checkpointing is
+        disabled. The candidate-loop resume contract every model family
+        shares: a killed batch layer re-runs ``run_update`` with the same
+        input slice (offsets were never committed), each candidate's
+        ``build_model`` recomputes the same fingerprint, and the trainer
+        resumes from the newest valid checkpoint instead of redoing the
+        generation — a kill -9 costs at most one checkpoint interval."""
+        from oryx_tpu.common import checkpoint as ckpt_mod
+
+        return ckpt_mod.from_config(self.config, fp, meta=meta)
+
     # -- BatchLayerUpdate (runUpdate:163-248) --------------------------------
     def run_update(self, context, timestamp_ms, new_data, past_data, model_dir, producer):
         new_data = list(new_data)

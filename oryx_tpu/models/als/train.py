@@ -939,6 +939,7 @@ def als_train(
     fused_gramian: "bool | None" = None,
     layout_cache: "BlockedLayoutCache | None" = None,
     timings: "dict | None" = None,
+    checkpointer=None,
 ):
     """Full alternating optimization; returns (X, Y) as jax arrays.
 
@@ -961,6 +962,17 @@ def als_train(
     on TPU (``ops/pallas_kernels.gather_gramian_accumulate``) and the
     einsum+segment-sum formulation elsewhere; ``True`` forces the kernel
     (interpret-emulated off-TPU — how the CPU suite tests the exact path).
+
+    **Preemption tolerance**: ``checkpointer`` (a
+    ``common/checkpoint.TrainerCheckpointer``) restores the newest valid
+    factor state for its data fingerprint before the loop and saves
+    ``{x, y}`` every interval (plus the final iteration) — each save
+    handed to a background writer so the device→host fetch and file write
+    overlap the next half-iteration, never blocking the device loop (the
+    blocked time is reported as ``timings["ckpt_wait_s"]``, asserted ≈0
+    by bench_batch). A restored checkpoint skips its completed iterations:
+    a killed trainer redoes at most one interval. Restore/save failures
+    degrade to from-scratch/skipped — checkpointing never fails a train.
 
     Single-device (no mesh): returns exact-shape ``(n_users, k)``/
     ``(n_items, k)`` arrays.
@@ -1046,11 +1058,66 @@ def als_train(
 
         if key is None:
             key = rand.get_key()
+        # resume: the newest valid checkpoint matching the data fingerprint
+        # replaces Y₀ (and skips its completed iterations); shape drift —
+        # a block-size or hyperparameter change that slipped past the
+        # fingerprint — falls back to a fresh start, never a bad gather
+        start_iter = 0
+        restored: "tuple | None" = None
+        if checkpointer is not None:
+            ck = checkpointer.restore()
+            if ck is not None:
+                rx, ry = ck.arrays.get("x"), ck.arrays.get("y")
+                if (rx is not None and ry is not None
+                        and rx.shape == (n_users, k)
+                        and ry.shape == (n_items, k)):
+                    restored = (np.asarray(rx, dtype=np.float32),
+                                np.asarray(ry, dtype=np.float32))
+                    start_iter = min(int(ck.step), iterations)
+                    checkpointer.mark_resumed(start_iter)
+                else:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "checkpoint %s does not match the current factor "
+                        "shapes; training from scratch", ck.path,
+                    )
+
+        def _maybe_ckpt(completed: int, x_arr, y_arr) -> None:
+            if checkpointer is None or not checkpointer.wants(
+                completed, iterations
+            ):
+                return
+            # exact-size slices: checkpoints are block-layout-agnostic,
+            # so a resume survives a changed block/mesh geometry
+            checkpointer.submit(
+                completed, {"x": x_arr[:n_users], "y": y_arr[:n_items]}
+            )
+
+        def _finish_ckpt() -> None:
+            if checkpointer is not None:
+                checkpointer.finish()
+                if timings is not None:
+                    # wait_s = mid-train joins only (the overlap evidence);
+                    # the final join mostly waits on the LAST iteration's
+                    # device compute, which a plain train pays too
+                    timings["ckpt_wait_s"] = round(checkpointer.wait_s, 3)
+                    timings["ckpt_final_wait_s"] = round(
+                        checkpointer.final_wait_s, 3
+                    )
+                    timings["ckpt_resumed_from"] = checkpointer.resumed_step
+
         # Y₀ needs only the item side's PADDED SHAPE, which is pure
         # arithmetic — the factor buffer (and the whole first user
         # half-iteration) must not wait on the item pack
-        y = _init_factors(_padded_rows_for(n_items, block_i, ndev), n_items,
-                          k, key)
+        if restored is not None:
+            y = jnp.zeros(
+                (_padded_rows_for(n_items, block_i, ndev), k),
+                dtype=jnp.float32,
+            ).at[:n_items].set(restored[1])
+        else:
+            y = _init_factors(_padded_rows_for(n_items, block_i, ndev),
+                              n_items, k, key)
 
         if mesh is not None and row_axis is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1064,8 +1131,24 @@ def als_train(
                     for a in (side.srows, side.scols, side.svals, side.slens)
                 )
 
-            u_arrays = put_side(user_side)
             y = jax.device_put(y, row_shard)
+            if start_iter >= iterations:
+                # fully-trained checkpoint (a crash between train end and
+                # publish): nothing to redo — re-pad X and keep the mesh
+                # contract (padded, row-partitioned factors). Checked
+                # BEFORE the user-side COO transfers to device or the
+                # solver builds: a zero-redo resume must not pay either.
+                finish_item_pack()
+                x = jax.device_put(
+                    jnp.zeros(
+                        (_padded_rows_for(n_users, block_u, ndev), k),
+                        dtype=jnp.float32,
+                    ).at[:n_users].set(restored[0]),
+                    row_shard,
+                )
+                _finish_ckpt()
+                return x, y
+            u_arrays = put_side(user_side)
             on_tpu = _use_spd_kernel(mesh=mesh)
             fused = _resolve_fused(fused_gramian, on_tpu, k)
             solve_u = _recorded_half("als.train.user_half", _sharded_solver(
@@ -1078,9 +1161,14 @@ def als_train(
                 mesh, row_axis, block_i, k, implicit, item_side.slot_chunk,
                 dtype, on_tpu, fused, not on_tpu))
             y = solve_i(x, *i_arrays, lam, alpha)
-            for _ in range(iterations - 1):
+            completed = start_iter + 1
+            _maybe_ckpt(completed, x, y)
+            for _ in range(iterations - start_iter - 1):
                 x = solve_u(y, *u_arrays, lam, alpha)
                 y = solve_i(x, *i_arrays, lam, alpha)
+                completed += 1
+                _maybe_ckpt(completed, x, y)
+            _finish_ckpt()
             return x, y
 
         def solve(side, opp, blk, ck):
@@ -1094,15 +1182,26 @@ def als_train(
                 slot_chunk=ck, dtype=dtype, fused_gramian=fused_gramian,
             )
 
-        # first user half-iteration dispatches against Y₀ while the item
-        # side is still packing on the worker thread
+        if start_iter >= iterations:
+            # fully-trained checkpoint: nothing to redo (the item pack
+            # worker still gets joined so timings/cache state stay sound)
+            finish_item_pack()
+            _finish_ckpt()
+            return jnp.asarray(restored[0]), jnp.asarray(restored[1])
+        # first user half-iteration dispatches against Y₀ (or the restored
+        # Y) while the item side is still packing on the worker thread
         x = solve(user_side, y, block_u, chunk_u)
         item_side, _ = finish_item_pack()
         chunk_i = item_side.slot_chunk
         y = solve(item_side, x, block_i, chunk_i)
-        for _ in range(iterations - 1):
+        completed = start_iter + 1
+        _maybe_ckpt(completed, x, y)
+        for _ in range(iterations - start_iter - 1):
             x = solve(user_side, y, block_u, chunk_u)
             y = solve(item_side, x, block_i, chunk_i)
+            completed += 1
+            _maybe_ckpt(completed, x, y)
+        _finish_ckpt()
         return x[:n_users], y[:n_items]
     finally:
         # JOIN the worker on every exit: after a user-pack failure an

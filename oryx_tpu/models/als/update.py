@@ -23,6 +23,7 @@ from typing import Sequence
 import numpy as np
 
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import checkpoint as ckpt_mod
 from oryx_tpu.common import rand
 from oryx_tpu.ml import param as hp
 from oryx_tpu.ml.mlupdate import MLUpdate
@@ -90,6 +91,25 @@ class ALSUpdate(MLUpdate):
         ctx_mesh = getattr(context, "mesh", None)
         if ctx_mesh is not None and ctx_mesh.size > 1 and "model" in ctx_mesh.axis_names:
             mesh, row_axis = ctx_mesh, "model"
+        # preemption tolerance: the checkpoint identity is the generation's
+        # DATA fingerprint — input-topic offsets (stamped on the context by
+        # the batch layer; None for direct/test callers), the candidate's
+        # hyperparameters, the batch shapes, and a CRC of the actual COO
+        # arrays — so a restarted generation resumes ONLY state built from
+        # exactly the data and settings it is about to train on
+        checkpointer = None
+        if ckpt_mod.enabled(self.config):
+            fp = ckpt_mod.fingerprint(
+                kind="als",
+                offsets=getattr(context, "input_offsets", None),
+                features=features, lam=lam, alpha=alpha, epsilon=epsilon,
+                implicit=self.implicit, iterations=self.iterations,
+                dtype=self.compute_dtype,
+                shape=[len(batch.users), len(batch.items), int(batch.nnz)],
+                data_crc=ckpt_mod.data_crc(batch.rows, batch.cols,
+                                           batch.vals),
+            )
+            checkpointer = self.make_checkpointer(fp)
         cache = (
             self._layout_cache
             if self._layout_cache_lock.acquire(blocking=False) else None
@@ -109,6 +129,7 @@ class ALSUpdate(MLUpdate):
                 dtype=self.compute_dtype,
                 layout_cache=cache,
                 timings=timings,
+                checkpointer=checkpointer,
             )
         finally:
             if cache is not None:

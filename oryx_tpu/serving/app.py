@@ -39,6 +39,7 @@ from oryx_tpu.common import resilience
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport import netbroker
+from oryx_tpu.transport import topic as tp
 from oryx_tpu.transport.topic import (
     ConsumeDataIterator,
     TopicProducerImpl,
@@ -327,6 +328,7 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     resilience.configure(config)
     faults.configure(config)
     netbroker.configure(config)  # tcp:// client timeouts/frame caps
+    tp.configure(config)  # file-broker fsync durability policy
     # factor-arena sizing (oryx.serving.arena.*): new vector stores built by
     # model handoffs in this process pick the slab seed/compaction knobs up
     from oryx_tpu.models.als import vectors as als_vectors
@@ -720,6 +722,7 @@ class ServingLayer:
         # tcp client knobs must be adopted BEFORE the first get_broker()
         # (start() resolves brokers well before make_app re-configures)
         netbroker.configure(config)
+        tp.configure(config)
         self.id = config.get_string("oryx.id", None)
         self.update_broker = config.get_string("oryx.update-topic.broker")
         self.update_topic = config.get_string("oryx.update-topic.message.topic")

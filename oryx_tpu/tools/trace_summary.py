@@ -595,6 +595,9 @@ def _history_row(label: str, rec: dict) -> dict:
         (_num(v.get("device_ratio_to_raw"))
          for k, v in (stores.get("device") or {}).items()
          if k.startswith("int8") and isinstance(v, dict)), None)
+    # round-12 durability section: checkpoint overhead (on-vs-off at the
+    # standard shape) and the wall a kill-and-resume saved vs recompute
+    ckpt = batch.get("checkpoint") or {}
     return {
         "round": label,
         "backend": rec.get("backend", "?"),
@@ -607,6 +610,8 @@ def _history_row(label: str, rec: dict) -> dict:
         "peak_rss_mb": _num(peak_mb),
         "arena_ratio": arena_ratio,
         "int8_ratio": int8_ratio,
+        "ckpt_ov_pct": _num(ckpt.get("ckpt_overhead_pct")),
+        "resume_saved_s": _num(ckpt.get("resume_saved_s")),
     }
 
 
@@ -627,7 +632,8 @@ def render_history(records: list, regress_pct: float = 25.0,
 
     w(f"{'round':>6s} {'backend':>8s} {'qps':>10s} {'http_qps':>9s} "
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
-      f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s}\n")
+      f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s} {'ckpt_ov':>7s} "
+      f"{'resume_sv':>9s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -642,7 +648,9 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['elapsed_s'], '{:9.2f}', 9)}{overlap}"
           f"{cell(r['peak_rss_mb'], '{:7.0f}MB', 9)} "
           f"{cell(r['arena_ratio'], '{:5.2f}x', 6)} "
-          f"{cell(r['int8_ratio'], '{:4.2f}x', 5)}\n")
+          f"{cell(r['int8_ratio'], '{:4.2f}x', 5)} "
+          f"{cell(r['ckpt_ov_pct'], '{:6.1f}%', 7)} "
+          f"{cell(r['resume_saved_s'], '{:8.1f}s', 9)}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]

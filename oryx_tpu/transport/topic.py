@@ -27,10 +27,18 @@ Semantics kept from the reference:
     max.request.size = 1<<26); topics support prefix truncation in lieu of
     Kafka retention.
 
-FileBroker writes each record as one O_APPEND write syscall (atomic between
-cooperating local processes; NFS append atomicity is not guaranteed — use one
-writer per topic there) and tolerates a partial trailing line from an
-in-flight writer by stopping before it.
+FileBroker writes each record as one flock-guarded O_APPEND write (atomic
+between cooperating local processes; NFS append atomicity is not guaranteed —
+use one writer per topic there). Records use a **versioned framing** — magic
++ length prefix + CRC32 ahead of the JSON payload — so truncation and
+bit-flips are detected, not silently consumed; legacy bare-JSON logs read
+back-compatibly. Durability is policy-driven (``oryx.broker.file.fsync`` =
+``never``/``interval``/``always``), and the first touch of each partition
+runs **torn-tail recovery**: a trailing partial record (a writer killed
+mid-append, or a crash under a lazy fsync policy) is scanned, truncated,
+and counted (``oryx_broker_torn_tail_records_total``) before any new
+append can splice into it. The ``tcp:`` netbroker wraps FileBroker as its
+single writer, so it inherits all of this for free.
 """
 
 from __future__ import annotations
@@ -44,6 +52,11 @@ import uuid
 import zlib
 from pathlib import Path
 from typing import Iterator
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover — non-posix fallback (no flock)
+    fcntl = None
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.common import faults
@@ -69,6 +82,50 @@ _CONSUMED = metrics_mod.default_registry().counter(
     "Messages handed to consumers from a topic",
     ("topic",),
 )
+_FSYNCS = metrics_mod.default_registry().counter(
+    "oryx_broker_fsyncs_total",
+    "Log fsyncs issued by the file broker (oryx.broker.file.fsync policy)",
+)
+_TORN_TAIL = metrics_mod.default_registry().counter(
+    "oryx_broker_torn_tail_records_total",
+    "Partial trailing records truncated by open-time log recovery",
+    ("topic",),
+)
+# same family the microbatch pump counts into (idempotent re-registration);
+# the consumer iterator counts skipped corrupt records under tier="transport"
+_CORRUPT_CONSUMED = metrics_mod.default_registry().counter(
+    "oryx_corrupt_records_total",
+    "Corrupt input-topic records dropped by the microbatch pump",
+    ("tier",),
+)
+
+
+def configure(config) -> None:
+    """Adopt ``oryx.broker.file.*`` process-wide (the resilience idiom:
+    layers, the serving app, and the broker CLI all call this, so the fsync
+    policy applies to every FileBroker instance — including the one inside
+    a ``tcp:`` netbroker server — without per-instance plumbing)."""
+    global _fsync_policy, _fsync_interval_sec
+    policy = config.get_string("oryx.broker.file.fsync", "never")
+    if policy not in ("never", "interval", "always"):
+        raise TopicException(
+            f"oryx.broker.file.fsync must be never/interval/always, "
+            f"got {policy!r}"
+        )
+    interval_ms = config.get_float("oryx.broker.file.fsync-interval-ms", 100.0)
+    _fsync_interval_sec = max(0.0, interval_ms) / 1000.0
+    _fsync_policy = policy
+
+
+#: process-wide fsync policy for FileBroker appends (see configure);
+#: plain module globals written under the GIL, read per append
+_fsync_policy = "never"
+_fsync_interval_sec = 0.1
+
+
+def _flock(fd: int, op: int) -> None:
+    if fcntl is not None:
+        fcntl.flock(fd, op)
 
 
 class TopicException(Exception):
@@ -135,6 +192,55 @@ def partitions_for_member(member_id: str, members: list[str], n_partitions: int)
 #: Placeholder returned for a corrupt log record so offsets stay aligned;
 #: ConsumeDataIterator filters it out by identity.
 CORRUPT_RECORD = KeyMessage(None, None)
+
+
+# ---------------------------------------------------------------------------
+# FileBroker record framing (version 1)
+# ---------------------------------------------------------------------------
+
+#: v1 frame: ``O1 <payload_len> <crc32:08x> <json payload>\n``. The length
+#: prefix catches truncation/splices, the CRC catches bit-flips, and the
+#: line stays newline-terminated so the byte index and offset model are
+#: unchanged. Legacy logs (bare ``{...}`` JSON lines) read back-compatibly.
+_FRAME_MAGIC = b"O1 "
+
+
+def frame_record(payload: bytes) -> bytes:
+    """One framed, newline-terminated log line for a JSON payload."""
+    return b"O1 %d %08x " % (len(payload), zlib.crc32(payload)) + payload + b"\n"
+
+
+def decode_record(raw: bytes, topic: str = "?") -> KeyMessage:
+    """One log line (no trailing newline) → KeyMessage, or CORRUPT_RECORD.
+
+    v1 frames are validated (length prefix AND CRC32) before the JSON is
+    trusted; bare ``{`` lines take the legacy path. Anything else — torn
+    splices, flipped bits, foreign garbage — maps to CORRUPT_RECORD so
+    offsets stay aligned and consumers skip exactly the bad record."""
+    payload = raw
+    if raw.startswith(_FRAME_MAGIC):
+        parts = raw.split(b" ", 3)
+        if len(parts) != 4:
+            log.warning("corrupt framed record in topic %s (bad header)", topic)
+            return CORRUPT_RECORD
+        _, len_s, crc_s, payload = parts
+        try:
+            want_len, want_crc = int(len_s), int(crc_s, 16)
+        except ValueError:
+            log.warning("corrupt framed record in topic %s (bad header)", topic)
+            return CORRUPT_RECORD
+        if len(payload) != want_len or zlib.crc32(payload) != want_crc:
+            log.warning(
+                "corrupt framed record in topic %s (CRC/length mismatch)",
+                topic,
+            )
+            return CORRUPT_RECORD
+    try:
+        d = json.loads(payload)
+        return KeyMessage(d["k"], d["m"], d.get("h"))
+    except (json.JSONDecodeError, KeyError, UnicodeDecodeError, TypeError):
+        log.warning("skipping corrupt record in topic %s", topic)
+        return CORRUPT_RECORD
 
 
 # ---------------------------------------------------------------------------
@@ -400,15 +506,19 @@ class MemoryBroker(Broker):
 
 
 class FileBroker(Broker):
-    """Append-only JSONL logs (one per partition) per topic under a directory.
+    """Append-only framed-record logs (one per partition) under a directory.
 
-    Appends are single O_APPEND write syscalls, atomic between cooperating
-    processes on a local filesystem. Reads keep a per-partition byte index
-    that extends incrementally, so polling cost is O(new bytes), not O(log
-    size). A partial trailing line (in-flight writer) is left for the next
-    read; corrupt interior lines are skipped with a warning. Consumer-group
-    membership rides heartbeat files (.groups/) with an mtime TTL, so
-    cooperating processes see each other without a coordinator.
+    Appends are flock-guarded O_APPEND writes of v1-framed lines (magic +
+    length prefix + CRC32 + JSON; legacy bare-JSON lines read
+    back-compatibly), with durability set by ``oryx.broker.file.fsync``.
+    Reads keep a per-partition byte index that extends incrementally, so
+    polling cost is O(new bytes), not O(log size). The first touch of a
+    partition runs torn-tail recovery (truncate + count a trailing partial
+    record); an in-flight writer's partial line is protected by the append
+    flock and simply left for the next read; corrupt interior lines map to
+    CORRUPT_RECORD with offsets aligned. Consumer-group membership rides
+    heartbeat files (.groups/) with an mtime TTL, so cooperating processes
+    see each other without a coordinator.
     """
 
     def __init__(self, root: str):
@@ -418,6 +528,16 @@ class FileBroker(Broker):
         # (topic, partition) -> line-start byte offsets incl. next-append pos
         self._index: dict[tuple[str, int], list[int]] = {}
         self._rr = itertools.count()  # per-process round-robin for None keys
+        # partitions whose tail this instance already recovered (first
+        # touch runs torn-tail truncation once; later partials belong to
+        # live flock-holding writers and are left alone). Values are
+        # completion events: a second thread racing the first touch WAITS
+        # for recovery instead of appending past a still-torn tail (its
+        # record would splice onto the partial and read back corrupt).
+        self._recovered: dict[tuple[str, int], threading.Event] = {}
+        # (topic, partition) -> monotonic time of the last fsync (the
+        # "interval" policy's due-date bookkeeping)
+        self._fsync_last: dict[tuple[str, int], float] = {}
 
     def _log_path(self, name: str, partition: int = 0) -> Path:
         return self._root / name / f"{partition:05d}.jsonl"
@@ -433,6 +553,8 @@ class FileBroker(Broker):
         with self._lock:
             for key in [k for k in self._index if k[0] == name]:
                 del self._index[key]
+            for key in [k for k in self._recovered if k[0] == name]:
+                del self._recovered[key]
 
     def topic_exists(self, name: str) -> bool:
         return self._log_path(name, 0).exists()
@@ -458,18 +580,123 @@ class FileBroker(Broker):
         p = self._log_path(topic, part)
         if not p.exists():
             raise TopicException(f"topic does not exist: {topic}")
+        self._ensure_recovered(topic, part, p)
         record = {"k": key, "m": message}
         if headers:
             record["h"] = headers
-        data = (json.dumps(record, separators=(",", ":")) + "\n").encode("utf-8")
+        data = frame_record(
+            json.dumps(record, separators=(",", ":")).encode("utf-8")
+        )
         fd = os.open(p, os.O_WRONLY | os.O_APPEND)
         try:
+            # the whole record writes under an exclusive flock: a short-write
+            # loop can no longer interleave with another process's append,
+            # and open-time recovery (which also takes the lock) can never
+            # truncate a LIVE writer's half-written record
+            _flock(fd, fcntl.LOCK_EX if fcntl else 0)
             written = os.write(fd, data)
-            # loop on short writes; only the first write is append-atomic, but
-            # a torn tail is better than a silently dropped one
             while written < len(data):
                 written += os.write(fd, data[written:])
+            self._maybe_fsync(fd, topic, part)
         finally:
+            if fcntl is not None:
+                _flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _maybe_fsync(self, fd: int, topic: str, part: int) -> None:
+        """Apply the configured durability policy after one append. An
+        fsync failure (disk error, injected ``broker.fsync`` fault) costs
+        durability for that window, never availability: the append already
+        landed in the page cache, so raising here would make the producer's
+        retry DOUBLE-append a record that was never lost."""
+        policy = _fsync_policy
+        if policy == "never":
+            return
+        if policy == "interval":
+            now = time.monotonic()
+            with self._lock:
+                last = self._fsync_last.get((topic, part), 0.0)
+                if now - last < _fsync_interval_sec:
+                    return
+                self._fsync_last[(topic, part)] = now
+        try:
+            faults.maybe_fail("broker.fsync")
+            os.fsync(fd)
+        except OSError:
+            log.warning(
+                "log fsync failed for %s/%d (durability degraded for this "
+                "window; append already applied)", topic, part, exc_info=True,
+            )
+            return
+        _FSYNCS.inc()
+
+    # -- torn-tail recovery ---------------------------------------------------
+    def _ensure_recovered(self, topic: str, part: int, p: Path) -> None:
+        key = (topic, part)
+        with self._lock:
+            done = self._recovered.get(key)
+            if done is None:
+                done = self._recovered[key] = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if owner:
+            try:
+                self._recover_tail(topic, part, p)
+            finally:
+                done.set()
+        else:
+            # block until the owner truncated the tail: appending before
+            # that would splice a good record onto the torn partial
+            done.wait()
+
+    def _recover_tail(self, topic: str, part: int, p: Path) -> None:
+        """Open-time crash recovery: scan the log tail and truncate a
+        trailing PARTIAL record (no terminating newline — a writer killed
+        mid-append, or a post-crash torn page under a lazy fsync policy),
+        counting what it dropped. Complete-but-corrupt interior records are
+        deliberately NOT touched here: they surface as CORRUPT_RECORD with
+        offsets aligned, so a mid-log bit-flip never costs the records
+        after it. Runs under the append flock, so an in-flight writer's
+        unfinished record is invisible to it."""
+        try:
+            fd = os.open(p, os.O_RDWR)
+        except FileNotFoundError:
+            return
+        try:
+            _flock(fd, fcntl.LOCK_EX if fcntl else 0)
+            size = os.lseek(fd, 0, os.SEEK_END)
+            if size == 0:
+                return
+            # scan backwards for the last newline (chunked: a partial
+            # record can be as large as the max message size)
+            pos, last_nl, chunk = size, -1, 1 << 16
+            while pos > 0 and last_nl < 0:
+                lo = max(0, pos - chunk)
+                os.lseek(fd, lo, os.SEEK_SET)
+                buf = os.read(fd, pos - lo)
+                nl = buf.rfind(b"\n")
+                if nl >= 0:
+                    last_nl = lo + nl
+                pos = lo
+            cut = last_nl + 1  # 0 when the whole file is one partial record
+            if cut == size:
+                return  # clean, newline-terminated tail
+            os.ftruncate(fd, cut)
+            os.fsync(fd)
+            _TORN_TAIL.labels(topic).inc()
+            log.warning(
+                "torn-tail recovery on %s/%d: truncated %d byte(s) of "
+                "partial trailing record", topic, part, size - cut,
+            )
+        except OSError:
+            log.warning(
+                "torn-tail recovery failed on %s/%d (reads still stop "
+                "before the partial tail)", topic, part, exc_info=True,
+            )
+        finally:
+            if fcntl is not None:
+                _flock(fd, fcntl.LOCK_UN)
             os.close(fd)
 
     def _refresh_index(self, topic: str, partition: int = 0) -> list[int]:
@@ -477,6 +704,7 @@ class FileBroker(Broker):
         p = self._log_path(topic, partition)
         if not p.exists():
             raise TopicException(f"topic/partition does not exist: {topic}/{partition}")
+        self._ensure_recovered(topic, partition, p)
         with self._lock:
             idx = self._index.setdefault((topic, partition), [0])
             scanned = idx[-1]
@@ -516,12 +744,7 @@ class FileBroker(Broker):
             if not raw.strip():
                 out.append(CORRUPT_RECORD)
                 continue
-            try:
-                d = json.loads(raw)
-                out.append(KeyMessage(d["k"], d["m"], d.get("h")))
-            except (json.JSONDecodeError, KeyError):
-                log.warning("skipping corrupt record in topic %s", topic)
-                out.append(CORRUPT_RECORD)  # keep offsets aligned
+            out.append(decode_record(raw, topic))  # keeps offsets aligned
         return out[: end - offset]
 
     def size(self, topic: str, partition: int = 0) -> int:
@@ -770,7 +993,20 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
             if (
                 self._last_assigned is not None
                 and set(assigned) - set(self._last_assigned)
-                and not self._closed.is_set()
+                and self._closed.is_set()
+            ):
+                # a CLOSING consumer must never claim new partitions — in
+                # any window. close() racing a peer's leave_group used to
+                # take the raw expanded view here (the hysteresis below
+                # was skipped exactly because closed was set), re-read the
+                # departed member's partitions from 0, and hand out
+                # duplicates before StopIteration landed.
+                assigned = [
+                    p for p in assigned if p in set(self._last_assigned)
+                ]
+            elif (
+                self._last_assigned is not None
+                and set(assigned) - set(self._last_assigned)
             ):
                 # rebalance hysteresis (ISSUE 11): GROWING the assignment on
                 # a single membership read is how a transient view (a
@@ -782,11 +1018,21 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 # immediate so two growers cannot overlap. Genuine takeover
                 # of a dead member's partitions just lands ~50 ms later.
                 self._closed.wait(0.05)
-                confirm = self._assignment_from_view()
-                if set(confirm) - set(self._last_assigned):
-                    assigned = confirm
+                if self._closed.is_set():
+                    # a CLOSING consumer must never claim new partitions:
+                    # close() racing a peer's leave_group used to let the
+                    # expansion proceed here, re-reading the departed
+                    # member's partitions from 0 and handing out duplicate
+                    # messages in the teardown window before StopIteration
+                    assigned = [
+                        p for p in assigned if p in set(self._last_assigned)
+                    ]
                 else:
-                    assigned = [p for p in assigned if p in set(confirm)]
+                    confirm = self._assignment_from_view()
+                    if set(confirm) - set(self._last_assigned):
+                        assigned = confirm
+                    else:
+                        assigned = [p for p in assigned if p in set(confirm)]
             self._last_assigned = assigned
             # rebalance hygiene: a partition lost to another member leaves
             # no residue — a stale _processed entry would let this member's
@@ -899,6 +1145,12 @@ class ConsumeDataIterator(Iterator[KeyMessage]):
                 batch = self._read_with_retry(p, off)
                 if batch:
                     self._offsets[p] = off + len(batch)
+                    n_corrupt = sum(1 for km in batch if km is CORRUPT_RECORD)
+                    if n_corrupt:
+                        # each corrupt offset is consumed (skipped) exactly
+                        # once per consumer — counted here, not in read(),
+                        # where re-polls would inflate the count
+                        _CORRUPT_CONSUMED.labels("transport").inc(n_corrupt)
                     self._buffer.extend(
                         (km, p, off + i + 1)
                         for i, km in enumerate(batch)

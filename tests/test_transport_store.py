@@ -211,22 +211,48 @@ def test_truncate_retention(broker_url):
         assert broker.size("T") == 2
 
 
-def test_file_broker_tolerates_partial_trailing_line(tmp_path):
+def test_file_broker_recovers_torn_tail_and_tolerates_inflight(tmp_path):
+    """First touch of a partition truncates a killed writer's partial
+    trailing record (torn-tail recovery, counted); AFTER recovery, a live
+    in-flight writer's partial line is simply left unindexed until its
+    newline lands — and a completed legacy (bare-JSON) line still reads."""
+    from oryx_tpu.common import metrics as metrics_mod
+
+    def torn_count() -> float:
+        snap = metrics_mod.default_registry().snapshot()
+        return snap.get(
+            "oryx_broker_torn_tail_records_total", {}
+        ).get('topic="T"', 0.0)
+
     url = f"file:{tmp_path}/broker"
     broker = tp.get_broker(url)
     broker.create_topic("T")
     tp.TopicProducerImpl(url, "T").send("a", "1")
-    # simulate an in-flight writer: partial line with no newline
+    # a writer killed -9 mid-append: partial line, no newline
     log = tmp_path / "broker" / "T" / "00000.jsonl"
+    clean_size = log.stat().st_size
     with open(log, "a") as f:
         f.write('{"k":"b","m":"2')
+    before = torn_count()
+    # first touch (this instance) runs recovery: partial truncated + counted
     assert broker.size("T") == 1
+    assert torn_count() == before + 1
+    assert log.stat().st_size == clean_size
     assert [km.key for km in broker.read("T", 0)] == ["a"]
-    # writer finishes the line
+    # appends continue cleanly at the recovered tail
+    broker.append("T", "b", "2")
+    assert [km.key for km in broker.read("T", 0)] == ["a", "b"]
+    # in-flight writer AFTER recovery: the partial stays unindexed (reads
+    # stop before it), and once the newline lands the record is consumable
+    # — including via the legacy bare-JSON framing
+    with open(log, "a") as f:
+        f.write('{"k":"c","m":"3')
+    assert broker.size("T") == 2
     with open(log, "a") as f:
         f.write('"}\n')
-    assert broker.size("T") == 2
-    assert [km.key for km in broker.read("T", 1)] == ["b"]
+    assert broker.size("T") == 3
+    assert [km.key for km in broker.read("T", 2)] == ["c"]
+    assert torn_count() == before + 1  # no further recovery ran
 
 
 def test_file_broker_skips_corrupt_interior_line(tmp_path):
@@ -530,9 +556,17 @@ def test_two_consumer_group_fanout(broker_url):
     got1, got2 = [], []
 
     def drain(it, got):
+        # STOP CONSUMING once the pair has everything, BEFORE any close():
+        # closing it1 while it2 still polls is a genuine rebalance — the
+        # survivor takes over the departed member's partitions from 0
+        # (correct at-least-once takeover in earliest mode with no
+        # commits) and would hand out re-read duplicates in the teardown
+        # window, flaking the exactly-once assertion below
         try:
             for km in it:
                 got.append(km.message)
+                if len(got1) + len(got2) >= 60:
+                    break
         except Exception:  # noqa: BLE001 — surfaces via the count assert below
             pass
 
@@ -768,3 +802,179 @@ def test_modelstore_promote_latest_gc(tmp_path):
     deleted = ms.delete_older_than(1, now_ms=2000 + 3600 * 1000)
     assert deleted == [d1]
     assert ms.model_dirs() == [d2]
+
+
+# ---------------------------------------------------------------------------
+# Durable-log integrity: framing, bit-flips, torn tails, fsync policy
+# (ISSUE 12: the log the checkpoint can trust)
+# ---------------------------------------------------------------------------
+
+
+def _metric(name: str, label: str = "") -> float:
+    from oryx_tpu.common import metrics as metrics_mod
+
+    snap = metrics_mod.default_registry().snapshot()
+    return snap.get(name, {}).get(label, 0.0)
+
+
+def test_file_broker_writes_versioned_crc_frames(tmp_path):
+    """New appends carry the v1 framing: magic + length prefix + CRC32
+    ahead of the JSON payload, one newline-terminated line per record."""
+    import zlib
+
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    broker.append("T", "k1", "hello world", {"h": "v"})
+    raw = (tmp_path / "broker" / "T" / "00000.jsonl").read_bytes()
+    assert raw.startswith(b"O1 ") and raw.endswith(b"\n")
+    _, len_s, crc_s, payload = raw[:-1].split(b" ", 3)
+    assert len(payload) == int(len_s)
+    assert zlib.crc32(payload) == int(crc_s, 16)
+    d = json.loads(payload)
+    assert d == {"k": "k1", "m": "hello world", "h": {"h": "v"}}
+    # and the decoder round-trips it
+    km = tp.decode_record(raw[:-1], "T")
+    assert (km.key, km.message, km.headers) == ("k1", "hello world", {"h": "v"})
+
+
+def test_legacy_bare_json_log_reads_back_compatibly(tmp_path):
+    """A pre-framing log (bare JSON lines) written by an old deployment
+    reads through the new broker unchanged — records, headers, offsets."""
+    d = tmp_path / "broker" / "T"
+    d.mkdir(parents=True)
+    with open(d / "00000.jsonl", "w") as f:
+        f.write('{"k":"a","m":"1"}\n')
+        f.write('{"k":"b","m":"2","h":{"traceparent":"00-x-y-01"}}\n')
+    broker = tp.get_broker(f"file:{tmp_path}/broker")
+    msgs = broker.read("T", 0)
+    assert [(km.key, km.message) for km in msgs] == [("a", "1"), ("b", "2")]
+    assert msgs[1].headers == {"traceparent": "00-x-y-01"}
+    # new appends interleave with legacy lines in the same log
+    broker.append("T", "c", "3")
+    assert [km.key for km in broker.read("T", 0)] == ["a", "b", "c"]
+
+
+@pytest.mark.parametrize("scheme", ["file", "tcp"])
+def test_corrupt_log_bitflip_and_torn_tail_exactly_once(tmp_path, scheme):
+    """THE corrupt-log fixture (ISSUE 12 satellite): flip a byte inside a
+    committed record and truncate mid-record at the tail. The consumer
+    skips exactly the flipped record (counted), torn-tail recovery
+    truncates the partial (counted), offsets stay consistent, and a
+    resume-after-restart from committed offsets reads everything else
+    exactly once — on both file: and tcp:."""
+    root = tmp_path / "broker"
+    seed = tp.get_broker(f"file:{root}")
+    seed.create_topic("T")
+    for i in range(6):
+        seed.append("T", str(i), f"m{i}")
+    log = root / "T" / "00000.jsonl"
+    lines = log.read_bytes().split(b"\n")
+    # bit-flip inside committed record 2's JSON payload
+    flipped = lines[2][:-1] + bytes([lines[2][-1] ^ 0x01])
+    lines[2] = flipped
+    log.write_bytes(b"\n".join(lines))
+    # torn write at the tail: half of a framed record, no newline
+    partial = tp.frame_record(b'{"k":"torn","m":"lost"}')[: 12]
+    with open(log, "ab") as f:
+        f.write(partial)
+
+    server = None
+    if scheme == "tcp":
+        from oryx_tpu.transport import netbroker
+
+        server = netbroker.NetBrokerServer(
+            str(root), host="127.0.0.1", port=0
+        ).start_background()
+        broker = tp.get_broker(f"tcp://127.0.0.1:{server.port}")
+    else:
+        broker = tp.get_broker(f"file:{root}")  # fresh instance: recovery runs
+    torn_before = _metric("oryx_broker_torn_tail_records_total", 'topic="T"')
+    corrupt_before = _metric("oryx_corrupt_records_total", 'tier="transport"')
+    try:
+        # size sees 6 committed records (torn tail truncated, flipped one
+        # still occupying its offset)
+        assert broker.size("T") == 6
+        assert _metric(
+            "oryx_broker_torn_tail_records_total", 'topic="T"'
+        ) == torn_before + 1
+        it = tp.ConsumeDataIterator(broker, "T", "earliest")
+        got = [next(it).key for _ in range(5)]
+        assert got == ["0", "1", "3", "4", "5"]  # exactly the bad one skipped
+        assert it.offset == 6  # offsets aligned across the corrupt slot
+        assert _metric(
+            "oryx_corrupt_records_total", 'tier="transport"'
+        ) == corrupt_before + 1
+        # commit after processing record "3" (position 4), restart: the
+        # resumed consumer re-reads exactly the rest, once
+        broker.set_offset("g", "T", 4)
+        it.close()
+        it2 = tp.ConsumeDataIterator(broker, "T", "committed", group="g")
+        assert [next(it2).key for _ in range(2)] == ["4", "5"]
+        it2.close()
+        # the recovered log is healthy: appends land and read back
+        broker.append("T", "post", "alive")
+        assert [km.key for km in broker.read("T", 6)] == ["post"]
+    finally:
+        if server is not None:
+            server.close()
+
+
+def test_fsync_policy_counters_and_validation(tmp_path):
+    from oryx_tpu.common import config as cfg
+
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    base = cfg.get_default()
+    try:
+        tp.configure(cfg.overlay_on({"oryx.broker.file.fsync": "always"}, base))
+        before = _metric("oryx_broker_fsyncs_total")
+        for i in range(4):
+            broker.append("T", str(i), "x")
+        assert _metric("oryx_broker_fsyncs_total") == before + 4
+        # interval: one fsync per window per partition (window >> test)
+        tp.configure(cfg.overlay_on(
+            {"oryx.broker.file.fsync": "interval",
+             "oryx.broker.file.fsync-interval-ms": 60_000}, base))
+        fresh = tp.get_broker(url)  # fresh instance: no fsync bookkeeping yet
+        before = _metric("oryx_broker_fsyncs_total")
+        for i in range(4):
+            fresh.append("T", str(i), "x")
+        assert _metric("oryx_broker_fsyncs_total") == before + 1
+        # never: no fsyncs at all
+        tp.configure(cfg.overlay_on({"oryx.broker.file.fsync": "never"}, base))
+        before = _metric("oryx_broker_fsyncs_total")
+        broker.append("T", "n", "x")
+        assert _metric("oryx_broker_fsyncs_total") == before
+        with pytest.raises(tp.TopicException):
+            tp.configure(cfg.overlay_on(
+                {"oryx.broker.file.fsync": "sometimes"}, base))
+    finally:
+        tp.configure(base)
+
+
+def test_fsync_fault_degrades_durability_not_availability(tmp_path):
+    """broker.fsync=fail:2 under fsync=always: appends still succeed (no
+    raise, no duplicate-inducing retry), the injections are visible, and
+    later fsyncs land."""
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.common import faults
+
+    url = f"file:{tmp_path}/broker"
+    broker = tp.get_broker(url)
+    broker.create_topic("T")
+    base = cfg.get_default()
+    tp.configure(cfg.overlay_on({"oryx.broker.file.fsync": "always"}, base))
+    before = _metric("oryx_broker_fsyncs_total")
+    faults.arm("broker.fsync=fail:2", seed=0)
+    try:
+        for i in range(4):
+            broker.append("T", str(i), "x")
+        stats = faults.stats()["broker.fsync"]
+        assert stats["injected"] == 2
+    finally:
+        faults.disarm()
+        tp.configure(base)
+    assert broker.size("T") == 4  # every append applied
+    assert _metric("oryx_broker_fsyncs_total") == before + 2  # 2 of 4 landed
