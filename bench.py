@@ -732,6 +732,37 @@ def _http_bench(model, queries, duration_s: float = 5.0,
         raise AssertionError(
             f"requests shed under nominal bench load: {resilience_counters}"
         )
+    # SLO verdict for the round (trace_summary --history renders it): the
+    # burn-rate engine make_app configured evaluates over the traffic just
+    # driven — nominal load must end the warm window with ZERO active
+    # alerts, or the round is reporting a qps number while burning budget
+    from oryx_tpu.common import slo as slo_mod
+
+    slo_status = slo_mod.status(force=True)
+    active_alerts = [
+        {"slo": name, "severity": severity}
+        for name, s in slo_status.items()
+        for severity, on in s["alerts"].items() if on
+    ]
+    slo_section = {
+        "objectives": {
+            name: {
+                "burn_rate_5m": round(s["burn_rate"].get("5m", 0.0), 3),
+                "budget_remaining": round(s["budget_remaining"], 4),
+            }
+            for name, s in slo_status.items()
+        },
+        "worst_burn_rate": round(max(
+            (b for s in slo_status.values()
+             for b in s["burn_rate"].values()), default=0.0,
+        ), 3),
+        "alerts_active": len(active_alerts),
+    }
+    if active_alerts:
+        raise AssertionError(
+            f"active SLO alerts under nominal bench load: {active_alerts} "
+            f"(status: {slo_status})"
+        )
     return {
         # headline = steady state; the cold split keeps the compile storm
         # visible instead of diluting the p99
@@ -748,6 +779,7 @@ def _http_bench(model, queries, duration_s: float = 5.0,
         "compiles_in_warm_window": int(warm_compiles),
         "warm_window_zero_compiles": warm_compiles == 0,
         "resilience": resilience_counters,
+        "slo": slo_section,
         "zero_sheds": resilience_counters["shed_requests_total"] == 0,
         "note": "GET /recommend through aiohttp + coalescer, device RTT "
                 "included; cold window contains the batch-size first-compiles",

@@ -92,11 +92,20 @@ class AbstractServingModelManager(ServingModelManager):
     (AbstractServingModelManager.java:88)."""
 
     def consume(self, updates: Iterator[KeyMessage]) -> None:
+        from oryx_tpu.common import blackbox
+
         for km in updates:
             if km.key in ("MODEL", "MODEL-REF"):
                 # counted before dispatch so every app family (ALS, k-means,
                 # RDF, examples) reports generations uniformly
                 _MODEL_GENERATIONS.inc()
+                # flight-recorder edge: a postmortem's first question about
+                # a misbehaving replica is "when did its model last change"
+                blackbox.record_event(
+                    "model.generation", key=km.key,
+                    message_bytes=len(km.message)
+                    if isinstance(km.message, (str, bytes)) else None,
+                )
             self.consume_key_message(km.key, km.message)
 
     @abc.abstractmethod

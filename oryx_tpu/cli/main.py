@@ -48,9 +48,13 @@ def _run_layer(layer_cls_path: str, config) -> int:
 
     layer_cls = getattr(importlib.import_module(module_name), cls_name)
     log.info("config:\n%s", config.pretty_print())
+    # the exit handler installs BEFORE the layer constructs: layer
+    # construction runs blackbox.configure, which (with a dump-dir set)
+    # CHAINS a flight-recorder dump in front of whatever SIGTERM handler
+    # exists — installing ours afterwards would silently drop the dump
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     layer = layer_cls(config)
     close_at_shutdown(layer)
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     layer.start()
     try:
         layer.await_termination()
@@ -165,6 +169,79 @@ def cmd_broker(argv: "list[str]") -> int:
     return 0
 
 
+def cmd_fleet_status(argv: "list[str]") -> int:
+    """Fleet-wide observability console (common/federation.py): scrape N
+    replicas' ``/metrics`` + ``/readyz`` + ``/trace``, merge them soundly
+    (counters sum, histograms add bucket-wise, gauges keep per-replica
+    labels, down replicas report down), and render an operator table, a
+    merged Prometheus ``fleet`` exposition, or JSON. ``--watch`` re-scrapes
+    on an interval and derives qps/error-rate from the deltas. Replica
+    list from ``--replicas`` (comma-separated, repeatable) or
+    ``oryx.fleet.replicas``. Runbook: docs/slo.md."""
+    parser = argparse.ArgumentParser(
+        prog="oryx-run fleet-status",
+        description="Oryx fleet observability console",
+    )
+    parser.add_argument(
+        "--replicas", action="append", default=[],
+        help="comma-separated replica targets (host:port or http URLs); "
+             "repeatable; default: oryx.fleet.replicas",
+    )
+    parser.add_argument("--conf", help="HOCON config file overlaid on defaults")
+    parser.add_argument(
+        "--watch", type=float, default=0.0, metavar="SEC",
+        help="re-scrape every SEC seconds (rate columns come from deltas); "
+             "0 = one shot",
+    )
+    parser.add_argument(
+        "--format", choices=["table", "prom", "json"], default="table",
+        help="table (operator view), prom (merged fleet exposition), json",
+    )
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="per-replica scrape budget (default: "
+                             "oryx.fleet.scrape-timeout-sec)")
+    args = parser.parse_args(argv)
+    config = _load_config(args.conf)
+    replicas = [
+        entry.strip()
+        for chunk in args.replicas for entry in chunk.split(",")
+        if entry.strip()
+    ]
+    if not replicas:
+        replicas = [str(r) for r in config.get_list("oryx.fleet.replicas", [])]
+    if not replicas:
+        print("fleet-status: no replicas (pass --replicas or set "
+              "oryx.fleet.replicas)", file=sys.stderr)
+        return 2
+    timeout = args.timeout if args.timeout is not None else config.get_float(
+        "oryx.fleet.scrape-timeout-sec", 5.0
+    )
+    from oryx_tpu.common import federation
+
+    prev = None
+    try:
+        while True:
+            snap = federation.scrape_fleet(replicas, timeout=timeout)
+            if args.format == "prom":
+                print(federation.render_prom(snap), end="")
+            elif args.format == "json":
+                import json as _json
+
+                print(_json.dumps(federation.to_json(snap, prev)))
+            else:
+                rows = federation.table_rows(snap, prev)
+                print(federation.render_table(rows), end="", flush=True)
+            if args.watch <= 0:
+                return 0
+            prev = snap
+            import time as _time
+
+            _time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_topic_input(config, args) -> int:
     """Feed stdin lines to the input topic (oryx-run.sh kafka-input)."""
     broker_url, name = _topics(config)["input"]
@@ -192,6 +269,10 @@ def main(argv: "list[str] | None" = None) -> int:
         # the tcp broker server is a pure-transport process: its own option
         # surface (--port/--dir/...), and it must never pay a jax import
         return cmd_broker(args_in[1:])
+    if args_in and args_in[0] == "fleet-status":
+        # the fleet aggregator is a pure-HTTP observer: its own option
+        # surface (--replicas/--watch/--format), never a jax import
+        return cmd_fleet_status(args_in[1:])
     parser = argparse.ArgumentParser(
         prog="oryx-run", description="Oryx TPU runner (oryx-run.sh equivalent)"
     )

@@ -50,6 +50,7 @@ from pathlib import Path
 
 import numpy as np
 
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import faults
 from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
@@ -375,8 +376,12 @@ class TrainerCheckpointer:
             host = {k: np.asarray(v) for k, v in arrays.items()}
             self._pending_fetch_s = time.perf_counter() - t0
             self.store.save(self.fingerprint, completed, host, meta)
-        except Exception:  # noqa: BLE001 — saves degrade, never kill training
+        except Exception as e:  # noqa: BLE001 — saves degrade, never kill training
             _SAVE_FAILURES.inc()
+            blackbox.record_event(
+                "ckpt.save_failure", severity="error", throttle_sec=1.0,
+                step=completed, error=f"{type(e).__name__}: {e}",
+            )
             log.warning(
                 "checkpoint save at step %d failed; training continues "
                 "without it", completed, exc_info=True,

@@ -27,8 +27,12 @@ the real faults they stand in for). Known sites: ``broker.append``,
 file broker's durability fsync — appends survive, durability degrades),
 ``ckpt.save`` / ``ckpt.load`` (fails trainer checkpoint writes/restores —
 training must complete anyway, common/checkpoint.py),
-``serving.update_consume``, ``serving.device_call`` (docs/robustness.md
-has the cookbook).
+``serving.update_consume``, ``serving.device_call``,
+``serving.request`` (fails/delays HTTP requests inside the serving
+middleware, probe/ops routes exempt — the SLO-burn game-day site), and
+``batch.generation`` / ``speed.generation`` (fails a whole microbatch
+generation through the quarantine machinery). docs/robustness.md has the
+cookbook.
 """
 
 from __future__ import annotations
@@ -131,6 +135,15 @@ def disarm() -> None:
 
 def armed() -> bool:
     return _sites is not None
+
+
+def site_armed(site: str) -> bool:
+    """True only when a schedule exists for THIS site — call sites that
+    must pay setup cost to inject (the serving middleware's executor hop)
+    check this instead of :func:`armed`, so a drill aimed at another site
+    costs them nothing."""
+    sites = _sites
+    return sites is not None and site in sites
 
 
 def configure(config) -> None:

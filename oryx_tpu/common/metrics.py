@@ -336,6 +336,20 @@ class Counter(_Family):
     def value(self) -> float:
         return self._default.value
 
+    def samples(self) -> "list[tuple[tuple, float]]":
+        """(label-values, value) pairs, unordered — the programmatic read
+        the SLO engine and health surfaces use instead of re-parsing
+        exposition. ONE lock acquisition for the whole family (children
+        share the family lock, so per-child ``.value`` reads would pay a
+        lock round-trip each — this walk runs on every scrape)."""
+        with self._lock:
+            out = [
+                (key, child._value) for key, child in self._children.items()
+            ]
+            if self._default is not None:
+                out.append(((), self._default._value))
+        return out
+
     def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
             ls = _label_str(self.labelnames, key)
@@ -367,6 +381,10 @@ class Gauge(_Family):
     @property
     def value(self) -> float:
         return self._default.value
+
+    def samples(self) -> "list[tuple[tuple, float]]":
+        """(label-values, value) pairs, callback gauges evaluated now."""
+        return [(key, child.value) for key, child in self._items()]
 
     def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
@@ -407,6 +425,23 @@ class Histogram(_Family):
     @property
     def sum(self) -> float:
         return self._default.sum
+
+    def bucket_samples(self) -> "list[tuple[tuple, list, float, int]]":
+        """(label-values, per-bucket raw counts with the +Inf overflow
+        last, sum, count) per child, unordered — the bounds are
+        :attr:`buckets`. The SLO engine's latency objective reads
+        cumulative under-threshold counts from this instead of parsing
+        its own exposition; like :meth:`Counter.samples`, one lock
+        acquisition covers the whole family."""
+        with self._lock:
+            out = [
+                (key, list(child._counts), child._sum, child._count)
+                for key, child in self._children.items()
+            ]
+            if self._default is not None:
+                d = self._default
+                out.append(((), list(d._counts), d._sum, d._count))
+        return out
 
     def render_samples(self, out: list, exemplars: bool = False) -> None:
         for key, child in self._items():
@@ -550,6 +585,48 @@ _DEFAULT_REGISTRY = MetricsRegistry()
 def default_registry() -> MetricsRegistry:
     """The process-wide registry every module instruments against."""
     return _DEFAULT_REGISTRY
+
+
+# -- standard process metrics (registered at registry init, so every tier
+# and the fleet table get them for free) ------------------------------------
+
+_PROCESS_START_TIME = _DEFAULT_REGISTRY.gauge(
+    "oryx_process_start_time_seconds",
+    "Unix time this process's metrics registry initialized "
+    "(uptime = scrape time minus this)",
+)
+_PROCESS_START_TIME.set(time.time())
+
+_BUILD_INFO = _DEFAULT_REGISTRY.gauge(
+    "oryx_build_info",
+    "Always 1 on the labels describing this process: framework version, "
+    "jax backend, and device kind (unknown until a backend initializes)",
+    ("version", "backend", "device_kind"),
+)
+
+
+def _framework_version() -> str:
+    try:
+        import oryx_tpu
+
+        return oryx_tpu.__version__
+    except Exception:  # noqa: BLE001 — partial-init import orders
+        return "unknown"
+
+
+def set_build_info(backend: str = "unknown",
+                   device_kind: str = "unknown") -> None:
+    """(Re-)point the build-info sample. Called once at import with the
+    backend unknown, and again by profiling's lazy jax wiring once the
+    real backend/device kind exist (the Prometheus info-metric idiom:
+    superseded label sets drop to 0, the current one reads 1)."""
+    version = _framework_version()
+    for key, _value in _BUILD_INFO.samples():
+        _BUILD_INFO.labels(*key).set(0.0)
+    _BUILD_INFO.labels(version, str(backend), str(device_kind)).set(1.0)
+
+
+set_build_info()
 
 
 def configure(config, registry: "MetricsRegistry | None" = None) -> MetricsRegistry:

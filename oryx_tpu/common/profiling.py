@@ -410,6 +410,12 @@ def _wire_jax_locked() -> None:
         devices = jax.devices()
     except Exception:  # noqa: BLE001 — no usable backend
         return
+    if devices:
+        # the oryx_build_info satellite (common/metrics.py): backend and
+        # device kind become known exactly here, the first moment a live
+        # backend exists in this process
+        metrics_mod.set_build_info(devices[0].platform,
+                                   devices[0].device_kind)
     for d in devices:
         label = f"{d.platform}:{d.id}"
         _DEV_IN_USE.labels(label).set_function(

@@ -386,6 +386,86 @@ oryx = {
     profile-dir = null
   }
 
+  # SLO burn-rate engine (common/slo.py): objectives evaluated continuously
+  # over the metrics registry at scrape time, exposed as
+  # oryx_slo_burn_rate{slo,window} / oryx_slo_error_budget_remaining /
+  # oryx_slo_alert_active with multi-window alerting (fast 5m/1h pair pages,
+  # slow 30m/6h pair tickets). /readyz embeds the active-alert list;
+  # docs/slo.md has the objective grammar and the window math.
+  slo = {
+    enabled = true
+    # Minimum requests in a window before its burn rate is reported (one
+    # failed request on a quiet replica must not page anyone).
+    min-events = 10
+    availability = {
+      enabled = true
+      # Percent of non-probe HTTP requests that must not answer 5xx.
+      objective = 99.9
+      # Error-budget accounting window (seconds) behind
+      # oryx_slo_error_budget_remaining.
+      window-sec = 86400
+    }
+    latency = {
+      # Off by default: a latency objective only means something against a
+      # deployment's own threshold (the CPU test container's nominal p99
+      # sits above any TPU-shaped default).
+      enabled = false
+      # Percent of non-probe requests that must finish under threshold-ms
+      # (the threshold snaps to the nearest latency-histogram bucket edge
+      # at or above it).
+      objective = 99.0
+      threshold-ms = 500
+      window-sec = 86400
+    }
+    burn-rate = {
+      # Page when BOTH the 5m and 1h burn rates exceed this (14.4 = the
+      # whole 30-day budget in ~2 days; Google SRE workbook defaults).
+      fast-threshold = 14.4
+      # Ticket when BOTH the 30m and 6h burn rates exceed this.
+      slow-threshold = 6
+    }
+  }
+
+  # Metrics federation / fleet-status (common/federation.py, `python -m
+  # oryx_tpu.cli fleet-status`): scrape N replicas' /metrics + /readyz +
+  # /trace and merge them soundly (counters sum, histograms add bucket-wise
+  # or fall back per-replica on edge mismatch, gauges keep per-replica
+  # labels with min/max/sum rollups, down replicas reported down).
+  fleet = {
+    # Replica scrape targets ("host:port" or full http(s):// base URLs);
+    # empty = pass --replicas on the CLI.
+    replicas = []
+    # Per-replica scrape budget; a replica slower than this reads as down
+    # for that scrape rather than stalling the fleet view.
+    scrape-timeout-sec = 5
+  }
+
+  # Black-box flight recorder (common/blackbox.py): a bounded in-process
+  # ring of structured operational events (breaker transitions,
+  # quarantines, sheds, consumer restarts, torn-tail recoveries,
+  # checkpoint save failures, SLO alert edges, model-generation swaps)
+  # behind GET /debug/bundle, auto-dumped so a dead replica leaves
+  # evidence (docs/slo.md "Runbook").
+  blackbox = {
+    # Ring capacity; evictions are counted in
+    # oryx_blackbox_events_dropped_total, never silent, and the ring can
+    # never grow a dying process's heap.
+    ring-size = 512
+    # Directory for bundle auto-dumps (SIGTERM, breaker-open/quarantine
+    # edges, and the periodic tick below). null disables dumping — the
+    # ring and GET /debug/bundle still work.
+    dump-dir = null
+    # Periodic flight-recorder tick: with a dump-dir set, a bundle lands
+    # at most this stale even across a kill -9. 0 disables the tick
+    # (edge-triggered and SIGTERM dumps still fire).
+    dump-interval-sec = 60
+    # Floor between two dumps — an edge storm must not thrash the disk
+    # (SIGTERM ignores it: the last words always land).
+    dump-min-interval-sec = 5
+    # Dump files retained per replica id (oldest deleted).
+    keep = 8
+  }
+
   # Framework-wide metrics registry + Prometheus text exposition on
   # GET /metrics (replaces the reference's Spark-UI/JMX metrics story;
   # docs/observability.md has the catalog).

@@ -37,6 +37,7 @@ import threading
 import time
 import weakref
 
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import metrics as metrics_mod
 
 _RETRIES = metrics_mod.default_registry().counter(
@@ -130,6 +131,18 @@ class RetryPolicy:
                     or (stop is not None and stop.is_set())
                 ):
                     _RETRIES.labels(site, "exhausted").inc()
+                    # flight-recorder evidence: an exhausted retry budget is
+                    # the moment a transient fault became a caller-visible
+                    # failure (throttled — a broker outage exhausts many
+                    # sites at once, and one event per second tells the
+                    # story as well as hundreds)
+                    blackbox.record_event(
+                        "retry.exhausted", severity="error",
+                        throttle_sec=1.0,
+                        throttle_key=f"retry.exhausted:{site}",
+                        site=site, attempts=attempt,
+                        error=f"{type(e).__name__}: {e}",
+                    )
                     raise
                 _RETRIES.labels(site, "retry").inc()
                 delay = self.backoff(attempt - 1)
@@ -142,6 +155,11 @@ class RetryPolicy:
                 continue
             if attempt:
                 _RETRIES.labels(site, "recovered").inc()
+                blackbox.record_event(
+                    "retry.recovered", throttle_sec=1.0,
+                    throttle_key=f"retry.recovered:{site}",
+                    site=site, attempts=attempt + 1,
+                )
             return result
 
 
@@ -225,8 +243,20 @@ class CircuitBreaker:
         # lock held by caller
         if self._state == to:
             return
+        from_state = self._state
         self._state = to
         _BREAKER_TRANSITIONS.labels(self.name, to).inc()
+        # flight-recorder evidence (and, on OPEN, a bundle dump trigger:
+        # an open breaker is the edge a postmortem asks about). The event
+        # append + dump wakeup are both non-blocking, so holding the
+        # breaker lock across them is fine.
+        blackbox.record_event(
+            "breaker.transition",
+            severity="error" if to == OPEN else "info",
+            dump=(to == OPEN),
+            breaker=self.name, from_state=from_state, to=to,
+            failures=self._failures,  # analyze: ignore[lock-discipline] -- _transition runs only under self._lock, taken by its callers
+        )
 
     @property
     def state(self) -> str:

@@ -14,12 +14,14 @@ import time
 from typing import Callable, Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import faults
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
+from oryx_tpu.common import slo
 from oryx_tpu.common import spans
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
@@ -63,6 +65,12 @@ class AbstractLayer:
         compilecache.configure(config)
         resilience.configure(config)
         faults.configure(config)
+        # flight recorder + SLO engine: batch/speed tiers record the same
+        # operational events (quarantines, retry exhaustion, checkpoint
+        # failures) and evaluate the same oryx.slo.* objectives as serving
+        # replicas — no tier is observability-dark
+        blackbox.configure(config)
+        slo.configure(config)
         netbroker.configure(config)  # tcp:// client timeouts/frame caps
         tp.configure(config)  # file-broker fsync durability policy
         # trainer cost accounting + memory gauges report through the same
@@ -297,20 +305,32 @@ class AbstractLayer:
         """One generation through the transient-vs-poison machinery; raises
         only on fatal-on-error (or during shutdown) — a quarantined
         generation returns normally so the caller advances offsets."""
+        site = f"{self.tier}.generation"
+
+        def attempt():
+            # chaos hook: an armed "<tier>.generation" schedule fails the
+            # generation through the exact path a poison input or a wedged
+            # device would take — the quarantine machinery absorbs it
+            faults.maybe_fail(site)
+            on_batch(timestamp_ms, batch)
+
         if self.fatal_on_error:
             # reference parity: no retry, first raise kills the layer
-            on_batch(timestamp_ms, batch)
+            attempt()
             return
         try:
-            self._generation_policy.call(
-                f"{self.tier}.generation",
-                lambda: on_batch(timestamp_ms, batch),
-                stop=self._stop,
-            )
+            self._generation_policy.call(site, attempt, stop=self._stop)
         except Exception as e:  # noqa: BLE001 — quarantine after retries
             if self._stop.is_set():
                 raise  # shutting down: spawn's guard discards it
             _QUARANTINED.labels(self.tier).inc()
+            # flight-recorder edge + dump trigger: an abandoned generation
+            # is exactly what the postmortem of a bad model asks about
+            blackbox.record_event(
+                "quarantine", severity="error", dump=True,
+                tier=self.tier, items=len(batch),
+                error=f"{type(e).__name__}: {e}",
+            )
             gen_span.record_exception(e)
             gen_span.set_attribute("quarantined", True)
             gen_span.set_attribute("items", len(batch))

@@ -29,6 +29,7 @@ import time
 from aiohttp import web
 
 from oryx_tpu.api.serving import ServingModelManager
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import faults
@@ -36,6 +37,7 @@ from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
+from oryx_tpu.common import slo
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport import netbroker
@@ -108,12 +110,28 @@ async def _metrics_middleware(request, handler):
     asyncio.to_thread, which copies it). The response echoes the trace via
     ``traceparent``/``x-oryx-trace-id`` so a slow client call can be pulled
     up by id from ``GET /trace``, and the request-latency histogram records
-    the trace id as its bucket exemplar — a bad bucket points at a trace."""
+    the trace id as its bucket exemplar — a bad bucket points at a trace.
+
+    Chaos: an armed ``serving.request`` fault schedule fires HERE (inside
+    the accounting, so injected 500s land in the SLO's availability counts
+    — the game-day site that drives a burn-rate alert on one replica).
+    Probe/ops routes are exempt: sabotaging /readyz or /metrics would blind
+    the very observability a drill exercises. The disarmed cost is one
+    global read per request; latency mode runs in a worker thread so an
+    injected sleep never stalls the event loop."""
     record = metrics_mod.default_registry().enabled
     tracing = spans.enabled()
-    if not record and not tracing:
-        return await handler(request)
     route = _route_template(request)
+
+    async def _handle():
+        # site_armed, not armed(): a drill aimed at broker.append must not
+        # tax every HTTP request with the injection's executor hop
+        if faults.site_armed("serving.request") and not slo.is_ops_route(route):
+            await asyncio.to_thread(faults.maybe_fail, "serving.request")
+        return await handler(request)
+
+    if not record and not tracing:
+        return await _handle()
     if record:
         _IN_FLIGHT.inc()
     t0 = time.perf_counter()
@@ -128,7 +146,7 @@ async def _metrics_middleware(request, handler):
             attributes={"route": route, "method": request.method},
         ) as sp:
             trace_id = sp.trace_id or None
-            response = await handler(request)
+            response = await _handle()
             status = response.status
             sp.set_attribute("status", status)
             if trace_id:
@@ -327,6 +345,11 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     compilecache.configure(config)
     resilience.configure(config)
     faults.configure(config)
+    # flight recorder (event ring, dump-dir, SIGTERM dump) and the SLO
+    # burn-rate engine (scrape-evaluated objectives; /readyz embeds the
+    # active-alert list) — both per-process, like the metrics registry
+    blackbox.configure(config)
+    slo.configure(config)
     netbroker.configure(config)  # tcp:// client timeouts/frame caps
     tp.configure(config)  # file-broker fsync durability policy
     # factor-arena sizing (oryx.serving.arena.*): new vector stores built by
@@ -412,11 +435,11 @@ def _exempt_canonicals(config) -> frozenset:
 
     ``/healthz``/``/readyz`` are ALWAYS exempt (load balancers cannot speak
     digest, and the probes leak nothing beyond up/down); ``/metrics``,
-    ``/trace``, and ``/debug/profile`` share one auth story — exempt unless
-    ``oryx.metrics.require-auth``."""
+    ``/trace``, ``/debug/profile``, and ``/debug/bundle`` share one auth
+    story — exempt unless ``oryx.metrics.require-auth``."""
     templates = {"/healthz", "/readyz"}
     if not config.get_bool("oryx.metrics.require-auth", False):
-        templates |= {"/metrics", "/trace", "/debug/profile"}
+        templates |= {"/metrics", "/trace", "/debug/profile", "/debug/bundle"}
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
     prefix = context_path.rstrip("/")
     return frozenset(templates | {prefix + t for t in templates})
@@ -846,6 +869,11 @@ class ServingLayer:
                     restarts += 1
                     self.consumer_restarts += 1  # lifetime-cumulative (tests)
                     _CONSUMER_RESTARTS.inc()
+                    blackbox.record_event(
+                        "consumer.restart", severity="error",
+                        restart=restarts,
+                        error=f"{type(e).__name__}: {e}",
+                    )
                     if 0 <= max_restarts < restarts:
                         log.exception(
                             "update consumer failed %d times; giving up and "

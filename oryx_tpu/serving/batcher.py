@@ -26,6 +26,7 @@ import asyncio
 import numpy as np
 
 from oryx_tpu.api.serving import OverloadedException
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import faults
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import resilience
@@ -178,6 +179,15 @@ class TopNCoalescer:
             # on a drained queue (or another replica)
             self.shed_requests += 1
             _SHED.inc()
+            # one throttled flight-recorder event per shed burst (the
+            # ``suppressed`` count carries the storm's size) — an overload
+            # must be reconstructable from a dead replica's bundle without
+            # letting the storm itself evict every other event
+            blackbox.record_event(
+                "shed", severity="warning", throttle_sec=1.0,
+                queue_depth=len(self._pending),
+                max_queue_depth=self.max_queue_depth,
+            )
             raise OverloadedException(
                 f"coalescer queue depth {len(self._pending)} >= "
                 f"{self.max_queue_depth}",

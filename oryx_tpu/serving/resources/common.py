@@ -22,9 +22,11 @@ import asyncio
 from aiohttp import web
 
 from oryx_tpu.api.serving import OryxServingException
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
+from oryx_tpu.common import slo as slo_mod
 from oryx_tpu.common import spans
 from oryx_tpu.serving import resource as rsrc
 
@@ -96,6 +98,13 @@ async def readyz(request: web.Request) -> web.Response:
         if lag_msgs > 0 and lag_sec > max_lag:
             detail["update_consumer"] = "stale"
             ok = False
+    # active SLO burn-rate alerts ride the probe body (docs/slo.md) so
+    # anything watching /readyz sees budget exhaustion — INFORMATIONAL
+    # only: a replica burning budget is exactly the replica that must NOT
+    # be rotated out of the balancer (less capacity burns faster). The
+    # evaluation takes the engine lock + registry family locks, so it
+    # hops to a worker thread like every other blocking probe read.
+    detail["slo_alerts"] = await asyncio.to_thread(slo_mod.active_alerts)
     detail["status"] = "ready" if ok else "unavailable"
     return web.json_response(detail, status=200 if ok else 503)
 
@@ -201,6 +210,22 @@ async def debug_profile(request: web.Request) -> web.Response:
     })
 
 
+async def debug_bundle(request: web.Request) -> web.Response:
+    """The black-box flight recorder's one-call postmortem artifact
+    (common/blackbox.py): event ring + metrics snapshot + slowest traces
+    + SLO status + redacted config + device/host memory + versions, as a
+    single JSON document. Assembly walks the registry and the span
+    reservoir, so it runs in a worker thread like /debug/profile — a
+    postmortem pull must not stall the replica being diagnosed. Auth
+    story = /metrics (exempt unless ``oryx.metrics.require-auth``).
+    The same bundle auto-dumps to ``oryx.blackbox.dump-dir`` on SIGTERM,
+    breaker-open/quarantine edges, and the periodic flight-recorder tick
+    — this endpoint is the live view of what a dead replica would have
+    left on disk."""
+    payload = await asyncio.to_thread(blackbox.bundle, "endpoint")
+    return web.json_response(payload)
+
+
 def register(app: web.Application) -> None:
     app.router.add_route("GET", "/ready", ready)
     app.router.add_route("HEAD", "/ready", ready)
@@ -212,3 +237,4 @@ def register(app: web.Application) -> None:
     app.router.add_route("GET", "/metrics", metrics)
     app.router.add_route("GET", "/trace", trace)
     app.router.add_route("POST", "/debug/profile", debug_profile)
+    app.router.add_route("GET", "/debug/bundle", debug_bundle)

@@ -598,6 +598,17 @@ def _history_row(label: str, rec: dict) -> dict:
     # round-12 durability section: checkpoint overhead (on-vs-off at the
     # standard shape) and the wall a kill-and-resume saved vs recompute
     ckpt = batch.get("checkpoint") or {}
+    # round-13 SLO section (bench.py --serving `http.slo`): worst burn
+    # rate over the bench windows, minimum budget remaining, alert count
+    # (asserted 0 under nominal load — a nonzero cell here means the
+    # bench's own gate was bypassed)
+    slo = (rec.get("http") or {}).get("slo") or {}
+    budgets = [
+        o.get("budget_remaining")
+        for o in (slo.get("objectives") or {}).values()
+        if isinstance(o, dict)
+    ]
+    budgets = [b for b in budgets if isinstance(b, (int, float))]
     return {
         "round": label,
         "backend": rec.get("backend", "?"),
@@ -612,6 +623,11 @@ def _history_row(label: str, rec: dict) -> dict:
         "int8_ratio": int8_ratio,
         "ckpt_ov_pct": _num(ckpt.get("ckpt_overhead_pct")),
         "resume_saved_s": _num(ckpt.get("resume_saved_s")),
+        "slo_burn": _num(slo.get("worst_burn_rate")),
+        "slo_budget": min(budgets) if budgets else None,
+        "slo_alerts": (int(slo["alerts_active"])
+                       if isinstance(slo.get("alerts_active"), (int, float))
+                       else None),
     }
 
 
@@ -633,7 +649,7 @@ def render_history(records: list, regress_pct: float = 25.0,
     w(f"{'round':>6s} {'backend':>8s} {'qps':>10s} {'http_qps':>9s} "
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
       f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s} {'ckpt_ov':>7s} "
-      f"{'resume_sv':>9s}\n")
+      f"{'resume_sv':>9s} {'burn':>6s} {'budget':>6s} {'alrt':>4s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -650,7 +666,10 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['arena_ratio'], '{:5.2f}x', 6)} "
           f"{cell(r['int8_ratio'], '{:4.2f}x', 5)} "
           f"{cell(r['ckpt_ov_pct'], '{:6.1f}%', 7)} "
-          f"{cell(r['resume_saved_s'], '{:8.1f}s', 9)}\n")
+          f"{cell(r['resume_saved_s'], '{:8.1f}s', 9)} "
+          f"{cell(r['slo_burn'], '{:6.2f}', 6)} "
+          f"{cell(r['slo_budget'], '{:6.3f}', 6)} "
+          f"{cell(r['slo_alerts'], '{:4d}', 4)}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]

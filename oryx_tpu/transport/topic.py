@@ -59,6 +59,7 @@ except ImportError:  # pragma: no cover — non-posix fallback (no flock)
     fcntl = None
 
 from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import blackbox
 from oryx_tpu.common import faults
 from oryx_tpu.common import ioutils
 from oryx_tpu.common import metrics as metrics_mod
@@ -685,6 +686,10 @@ class FileBroker(Broker):
             os.ftruncate(fd, cut)
             os.fsync(fd)
             _TORN_TAIL.labels(topic).inc()
+            blackbox.record_event(
+                "broker.torn_tail", severity="warning",
+                topic=topic, partition=part, truncated_bytes=size - cut,
+            )
             log.warning(
                 "torn-tail recovery on %s/%d: truncated %d byte(s) of "
                 "partial trailing record", topic, part, size - cut,

@@ -1,0 +1,253 @@
+"""Flight recorder (common/blackbox.py): ring bounds, throttling, bundle
+assembly, dumps, and the /debug/bundle endpoint."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from oryx_tpu.common import blackbox
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import metrics as metrics_mod
+
+
+def _dropped() -> float:
+    snap = metrics_mod.default_registry().snapshot()
+    return snap.get("oryx_blackbox_events_dropped_total", {}).get("", 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    blackbox.reset_for_tests()
+    yield
+    blackbox.reset_for_tests()
+
+
+def test_ring_is_bounded_and_drop_counted():
+    """The acceptance property of the ring: it can NEVER grow a dying
+    process's heap — past capacity the oldest event evicts and the drop
+    is counted, not silent."""
+    ring = blackbox.EventRing(size=16)
+    before = _dropped()
+    for i in range(50):
+        ring.record({"kind": f"k{i}", "ts": i})
+    events = ring.snapshot()
+    assert len(events) == 16
+    # newest survive, oldest evicted
+    assert events[-1]["kind"] == "k49"
+    assert events[0]["kind"] == "k34"
+    assert _dropped() - before == 34
+
+
+def test_throttle_coalesces_same_kind_storm():
+    ring = blackbox.EventRing(size=64)
+    kept = sum(
+        ring.record({"kind": "shed"}, throttle_sec=10.0) for _ in range(100)
+    )
+    assert kept == 1
+    events = ring.snapshot()
+    assert len(events) == 1
+    assert events[0]["suppressed"] == 99
+    # a different kind is never caught by another kind's throttle window
+    assert ring.record({"kind": "quarantine"}, throttle_sec=10.0)
+    # distinct throttle KEYS within one kind stay distinct stories
+    assert ring.record({"kind": "retry"}, throttle_sec=10.0,
+                       throttle_key="retry:a")
+    assert ring.record({"kind": "retry"}, throttle_sec=10.0,
+                       throttle_key="retry:b")
+
+
+def test_snapshot_returns_copies_immune_to_throttle_mutation():
+    """The throttle path keeps bumping the live event's ``suppressed``
+    count — a snapshot handed to a json serializer must not alias it
+    (dict-changed-size mid-iteration during the very overload the
+    recorder exists to capture)."""
+    ring = blackbox.EventRing(size=16)
+    ring.record({"kind": "shed"}, throttle_sec=10.0)
+    snap = ring.snapshot()
+    ring.record({"kind": "shed"}, throttle_sec=10.0)  # mutates the LIVE event
+    assert "suppressed" not in snap[0]  # the copy is frozen
+    assert ring.snapshot()[0]["suppressed"] == 1
+
+
+def test_record_event_truncates_attrs_and_counts_kind():
+    snap_before = metrics_mod.default_registry().snapshot().get(
+        "oryx_blackbox_events_total", {}
+    ).get('kind="unit.test"', 0.0)
+    blackbox.record_event("unit.test", error="x" * 10_000, n=3, skipped=None)
+    ev = blackbox.events()[-1]
+    assert ev["kind"] == "unit.test"
+    assert len(ev["error"]) <= 400
+    assert ev["n"] == 3
+    assert "skipped" not in ev  # None attrs dropped
+    snap_after = metrics_mod.default_registry().snapshot().get(
+        "oryx_blackbox_events_total", {}
+    ).get('kind="unit.test"', 0.0)
+    assert snap_after - snap_before == 1
+
+
+def test_bundle_sections_present_and_degrade_independently():
+    config = cfg.overlay_on(
+        {"oryx.id": "bundle-test", "oryx.serving.api.password": "hunter2"},
+        cfg.get_default(),
+    )
+    blackbox.configure(config)
+    blackbox.record_event("breaker.transition", breaker="b", to="open")
+    b = blackbox.bundle("unit")
+    assert b["reason"] == "unit"
+    assert b["oryx_id"] == "bundle-test"
+    assert any(e["kind"] == "breaker.transition" for e in b["events"])
+    assert "oryx_blackbox_events_total" in b["metrics"]
+    assert b["versions"]["python"]
+    assert b["versions"]["oryx_tpu"]
+    # config rides REDACTED: the password literal must never reach a bundle
+    assert b["config"]["oryx.serving.api.password"] == "*****"
+    serialized = json.dumps(b)
+    assert "hunter2" not in serialized
+
+
+def test_dump_writes_atomic_file_and_gcs_to_keep(tmp_path):
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "dump-test",
+            "oryx.blackbox.dump-dir": str(tmp_path),
+            "oryx.blackbox.dump-interval-sec": 0,
+            "oryx.blackbox.dump-min-interval-sec": 0,
+            "oryx.blackbox.keep": 3,
+        },
+        cfg.get_default(),
+    )
+    blackbox.configure(config)
+    paths = []
+    for i in range(6):
+        blackbox.record_event("unit.dump", i=i)
+        p = blackbox.dump(f"r{i}", force=True)
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)  # distinct millisecond timestamps in filenames
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".json"))
+    assert len(files) == 3, files  # GC'd to keep
+    newest = json.loads((tmp_path / files[-1]).read_text())
+    assert newest["reason"] == "r5"
+    assert any(e["kind"] == "unit.dump" for e in newest["events"])
+
+
+def test_dump_rate_limit_and_disabled_dir():
+    # no dump-dir: dump is a clean no-op
+    assert blackbox.dump("nowhere") is None
+    blackbox.trigger_dump("nowhere")  # no-op, no thread, no error
+
+
+def test_rate_limited_edge_dump_is_deferred_not_dropped(tmp_path):
+    """An edge dump landing inside dump-min-interval-sec must eventually
+    land (the breaker-open bundle is exactly the evidence the edge dump
+    exists for), not be silently consumed by the rate window."""
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "defer-test",
+            "oryx.blackbox.dump-dir": str(tmp_path),
+            "oryx.blackbox.dump-interval-sec": 0,
+            "oryx.blackbox.dump-min-interval-sec": 1,
+        },
+        cfg.get_default(),
+    )
+    blackbox.configure(config)  # fires the startup dump, arming the window
+    deadline = time.monotonic() + 10
+    while not any(
+        f.endswith("-startup.json") for f in os.listdir(tmp_path)
+    ):
+        assert time.monotonic() < deadline, os.listdir(tmp_path)
+        time.sleep(0.05)
+    # an edge inside the rate window: deferred by the dumper, landing once
+    # the window opens — never dropped
+    blackbox.record_event("breaker.transition", dump=True, to="open")
+    deadline = time.monotonic() + 10
+    while not any(
+        f.endswith("-breaker.transition.json") for f in os.listdir(tmp_path)
+    ):
+        assert time.monotonic() < deadline, os.listdir(tmp_path)
+        time.sleep(0.05)
+
+
+def test_min_interval_floors_edge_storms(tmp_path):
+    config = cfg.overlay_on(
+        {
+            "oryx.blackbox.dump-dir": str(tmp_path),
+            "oryx.blackbox.dump-interval-sec": 0,
+            "oryx.blackbox.dump-min-interval-sec": 30,
+        },
+        cfg.get_default(),
+    )
+    blackbox.configure(config)
+    assert blackbox.dump("first", force=True) is not None
+    # an edge storm inside the floor is absorbed...
+    assert blackbox.dump("second") is None
+    # ...but SIGTERM-style forced dumps always land
+    assert blackbox.dump("forced", force=True) is not None
+
+
+def test_sigterm_leaves_a_dump_from_a_real_layer(tmp_path):
+    """A real `cli serving` process SIGTERM'd must leave a bundle on disk
+    (the chained handler dumps BEFORE the cli's sys.exit) — the graceful
+    half of the black-box story; the kill -9 half (periodic tick) is
+    asserted by the fleet IT."""
+    from oryx_tpu.common import ioutils
+
+    port = ioutils.choose_free_port()
+    dump_dir = tmp_path / "dumps"
+    conf = tmp_path / "app.conf"
+    conf.write_text(f"""
+oryx {{
+  id = "sigterm-dump"
+  serving {{
+    api.port = {port}
+    api.read-only = true
+    model-manager-class = "tests.fleet_app.FleetServingModelManager"
+    application-resources = "tests.fleet_app"
+  }}
+  blackbox {{
+    dump-dir = "{dump_dir}"
+    dump-interval-sec = 3600
+  }}
+}}
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ORYX_FLEET_DIR=str(tmp_path))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "oryx_tpu.cli", "serving", "--conf",
+         str(conf)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        cwd=os.getcwd(),
+    )
+    try:
+        import httpx
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if httpx.get(f"http://127.0.0.1:{port}/healthz",
+                             timeout=2).status_code == 200:
+                    break
+            except httpx.TransportError:
+                time.sleep(0.2)
+        else:
+            pytest.fail("serving subprocess never became live")
+        proc.send_signal(signal.SIGTERM)
+        # 0, not just "exited": the chained dump handler must hand control
+        # back to the cli's clean sys.exit
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    dumps = sorted(
+        f for f in os.listdir(dump_dir) if f.endswith("-sigterm.json")
+    )
+    assert dumps, sorted(os.listdir(dump_dir))
+    doc = json.loads((dump_dir / dumps[-1]).read_text())
+    assert doc["reason"] == "sigterm"
+    assert doc["oryx_id"] == "sigterm-dump"
+    assert "metrics" in doc

@@ -38,6 +38,17 @@ def _counter(name: str, label: str = "") -> float:
     return snap.get(name, {}).get(label, 0.0)
 
 
+def _bundle_events(client, kind: str, **attrs) -> list:
+    """Events of one kind (attrs filtering) from GET /debug/bundle — every
+    injected fault family must leave matching structured evidence in the
+    flight recorder (ISSUE 13 satellite)."""
+    bundle = client.get("/debug/bundle").json()
+    return [
+        e for e in bundle["events"]
+        if e["kind"] == kind and all(e.get(k) == v for k, v in attrs.items())
+    ]
+
+
 def _metric_line(text: str, name: str, label_frag: str) -> float:
     for line in text.splitlines():
         if line.startswith(name) and label_frag in line:
@@ -150,6 +161,9 @@ def test_chaos_broker_faults_drop_no_inflight_requests(chaos_pair):
     assert _counter(
         "oryx_retries_total", 'site="broker.append",outcome="recovered"'
     ) - recovered_before >= 1
+    # flight-recorder evidence: the absorbed fault family left a
+    # structured retry.recovered event in /debug/bundle
+    assert _bundle_events(client, "retry.recovered", site="broker.append")
     # both layers are still alive and well
     assert not speed.stopped
     assert client.get("/readyz").status_code == 200
@@ -191,6 +205,8 @@ def test_chaos_update_consumer_crash_restarts_within_budget(chaos_pair):
     else:
         pytest.fail("/readyz never recovered after the consumer restart")
     assert client.get(f"/recommend/{user}").status_code == 200
+    # the crash family's flight-recorder evidence
+    assert _bundle_events(client, "consumer.restart")
 
 
 def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
@@ -239,6 +255,44 @@ def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
             text, "oryx_circuit_breaker_transitions_total",
             f'breaker="serving.device_call",to="{target}"',
         ) >= 1.0, f"no {target} transition recorded"
+    # ...and in the flight recorder: the open edge and the recovery both
+    # left structured events (the open edge also triggers a dump when a
+    # dump-dir is configured)
+    assert _bundle_events(client, "breaker.transition",
+                          breaker="serving.device_call", to="open")
+    assert _bundle_events(client, "breaker.transition",
+                          breaker="serving.device_call", to="closed")
+
+
+def test_chaos_generation_quarantine_leaves_event_and_layer_lives(chaos_pair):
+    """A generation failing past its retry budget (fault family:
+    quarantine): the speed layer quarantines it — offsets advance, the
+    layer lives — and the flight recorder carries the structured
+    quarantine event for the postmortem."""
+    client, serving, speed, user, broker_url = chaos_pair
+    quarantined_before = _counter(
+        "oryx_quarantined_generations_total", 'tier="speed"'
+    )
+    # generation.max-retries defaults to 2 -> 3 attempts; fail all 3 so
+    # the generation quarantines, then the schedule clears
+    faults.arm("speed.generation=fail:3", seed=0)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _counter(
+                "oryx_quarantined_generations_total", 'tier="speed"'
+            ) > quarantined_before:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("generation was never quarantined")
+    finally:
+        faults.disarm()
+    events = _bundle_events(client, "quarantine", tier="speed")
+    assert events and events[-1]["severity"] == "error"
+    # the layer lived through it and the HTTP side never blinked
+    assert not speed.stopped
+    assert client.get(f"/recommend/{user}").status_code == 200
 
 
 def test_chaos_warm_window_clean_after_disarm(chaos_pair):

@@ -273,6 +273,10 @@ def test_chaos_ckpt_save_failures_degrade_never_kill_training(tmp_path):
     ) == failures_before + 2
     # saves 1-2 (steps 2, 4) were injected away; save 3 (step 6) landed
     assert store.steps(FP) == [6]
+    # the failures left flight-recorder evidence for the postmortem
+    from oryx_tpu.common import blackbox
+
+    assert any(e["kind"] == "ckpt.save_failure" for e in blackbox.events())
 
 
 def test_chaos_ckpt_load_failure_trains_from_scratch(tmp_path):

@@ -1,0 +1,166 @@
+"""Metrics federation (common/federation.py): merge soundness, down-replica
+handling, the operator table, and a live-HTTP scrape."""
+
+import http.server
+import json
+import threading
+
+from oryx_tpu.common import federation as fed
+
+T_BASE = """# TYPE oryx_serving_requests_total counter
+oryx_serving_requests_total{method="GET",route="/r",status="200"} 10
+oryx_serving_requests_total{method="GET",route="/r",status="500"} 2
+oryx_serving_requests_total{method="GET",route="/metrics",status="200"} 99
+# TYPE oryx_device_mfu gauge
+oryx_device_mfu 0.5
+# TYPE oryx_serving_request_latency_seconds histogram
+oryx_serving_request_latency_seconds_bucket{route="/r",le="0.1"} 5
+oryx_serving_request_latency_seconds_bucket{route="/r",le="1"} 9
+oryx_serving_request_latency_seconds_bucket{route="/r",le="+Inf"} 12
+oryx_serving_request_latency_seconds_sum{route="/r"} 1.5
+oryx_serving_request_latency_seconds_count{route="/r"} 12
+"""
+
+
+def _scrape_from_text(url: str, text: str) -> fed.ReplicaScrape:
+    r = fed.ReplicaScrape(url)
+    r.up = True
+    r.types = fed.parse_types(text)
+    r.histograms, r.scalars = fed.parse_metrics_text(text)
+    return r
+
+
+def test_counters_sum_histograms_add_bucketwise_gauges_stay_per_replica():
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    r2 = _scrape_from_text("http://b:2", T_BASE)
+    m = fed.merge(fed.FleetSnapshot([r1, r2]))
+    key = (("method", "GET"), ("route", "/r"), ("status", "200"))
+    assert m.counters["oryx_serving_requests_total"][key] == 20.0
+    assert m.gauges["oryx_device_mfu"][()] == {"a:1": 0.5, "b:2": 0.5}
+    h = m.histograms["oryx_serving_request_latency_seconds"][(("route", "/r"),)]
+    assert h["buckets"] == [(0.1, 10.0), (1.0, 18.0), (float("inf"), 24.0)]
+    assert h["count"] == 24.0
+    assert not m.histogram_fallback
+
+
+def test_bucket_mismatch_falls_back_per_replica_never_mismerges():
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    # replica b runs different bucket edges (mid-rollout histogram change)
+    r2 = _scrape_from_text("http://b:2", T_BASE.replace('le="0.1"', 'le="0.25"'))
+    m = fed.merge(fed.FleetSnapshot([r1, r2]))
+    assert "oryx_serving_request_latency_seconds" not in m.histograms
+    fallback = m.histogram_fallback["oryx_serving_request_latency_seconds"]
+    assert ("a:1", (("route", "/r"),)) in fallback
+    assert ("b:2", (("route", "/r"),)) in fallback
+    text = fed.render_prom(fed.FleetSnapshot([r1, r2]), m)
+    assert 'replica="a:1",route="/r",le="0.1"' in text.replace(
+        'route="/r",replica="a:1"', 'replica="a:1",route="/r"'
+    ) or "replica=" in text  # per-replica rows rendered
+
+
+def test_down_replica_reported_not_poisoning():
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    r_down = fed.ReplicaScrape("http://dead:9")
+    r_down.error = "ConnectionRefusedError: [Errno 111]"
+    snap = fed.FleetSnapshot([r1, r_down])
+    m = fed.merge(snap)
+    key = (("method", "GET"), ("route", "/r"), ("status", "200"))
+    assert m.counters["oryx_serving_requests_total"][key] == 10.0
+    text = fed.render_prom(snap, m)
+    assert 'oryx_fleet_replica_up{replica="a:1"} 1' in text
+    assert 'oryx_fleet_replica_up{replica="dead:9"} 0' in text
+    rows = fed.table_rows(snap)
+    down = next(r for r in rows if r["replica"] == "dead:9")
+    assert down["up"] is False and "ConnectionRefused" in down["error"]
+    fleet = rows[-1]
+    assert fleet["replica"] == "FLEET"
+    assert fleet["n_up"] == 1 and fleet["n_total"] == 2
+    # renders without raising, down replica visibly DOWN
+    assert "DOWN" in fed.render_table(rows)
+
+
+def test_table_excludes_ops_routes_and_counts_errors():
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    row = fed.replica_row(r1)
+    # the /metrics route's 99 scrapes are excluded; 10+2 user requests stay
+    assert row["requests_total"] == 12.0
+    assert row["errors_total"] == 2.0
+    assert abs(row["error_pct"] - 100.0 * 2 / 12) < 1e-9
+    assert row["qps"] is None  # one-shot: no rate without a prior scrape
+    assert row["p50_ms"] is not None and row["p99_ms"] is not None
+
+
+def test_watch_mode_rates_come_from_deltas():
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    later = T_BASE.replace(
+        'status="200"} 10', 'status="200"} 110'
+    )
+    r1b = _scrape_from_text("http://a:1", later)
+    snap1 = fed.FleetSnapshot([r1])
+    snap2 = fed.FleetSnapshot([r1b])
+    snap2.time = snap1.time + 10.0
+    rows = fed.table_rows(snap2, prev=snap1)
+    assert rows[0]["qps"] == 10.0  # 100 new requests / 10s
+    # delta errors are zero, so the WINDOWED error rate reads 0 even
+    # though lifetime errors exist — and the FLEET row aggregates the
+    # SAME window (a lifetime ratio there would paint a recovered fleet
+    # as actively erroring)
+    assert rows[0]["error_pct"] == 0.0
+    assert rows[-1]["replica"] == "FLEET"
+    assert rows[-1]["error_pct"] == 0.0
+    # the internal window-delta scratch never leaks into the API rows
+    assert not any(k.startswith("_d_") for r in rows for k in r)
+
+
+def test_scrape_one_against_live_http_server():
+    """End-to-end scrape over real sockets: /metrics + /readyz (503 body
+    still parsed — an unready replica is up, not down)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = T_BASE.encode()
+                self.send_response(200)
+            elif self.path == "/readyz":
+                body = json.dumps(
+                    {"status": "unavailable", "model": "not loaded"}
+                ).encode()
+                self.send_response(503)
+            else:
+                body = b"{}"
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        snap = fed.scrape_fleet(
+            [f"127.0.0.1:{port}", "127.0.0.1:1"], timeout=5.0
+        )
+        live, dead = snap.replicas
+        assert live.up and not dead.up
+        assert dead.error
+        assert live.readyz["status"] == "unavailable"
+        assert not live.ready
+        key = (("method", "GET"), ("route", "/r"), ("status", "200"))
+        assert fed.merge(snap).counters["oryx_serving_requests_total"][key] == 10.0
+        doc = fed.to_json(snap)
+        assert doc["replicas"][0]["up"] is True
+        assert doc["replicas"][1]["up"] is False
+        assert json.dumps(doc)  # fully JSON-serializable
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_normalize_url():
+    assert fed.normalize_url("host:8080") == "http://host:8080"
+    assert fed.normalize_url("http://host:8080/") == "http://host:8080"
+    assert fed.normalize_url("https://h/api/") == "https://h/api"

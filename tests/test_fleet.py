@@ -45,7 +45,8 @@ def _wait_tcp(port: int, timeout: float = 30.0) -> None:
     pytest.fail(f"nothing listening on 127.0.0.1:{port} after {timeout}s")
 
 
-def _replica_conf(tmp_path, rid: str, http_port: int, broker_url: str) -> str:
+def _replica_conf(tmp_path, rid: str, http_port: int, broker_url: str,
+                  extra: str = "") -> str:
     conf = tmp_path / f"{rid}.conf"
     conf.write_text(f"""
 oryx {{
@@ -59,6 +60,7 @@ oryx {{
     application-resources = "tests.fleet_app"
     update-resume = "committed"
   }}
+  {extra}
 }}
 """)
     return str(conf)
@@ -244,6 +246,217 @@ def test_fleet_kill9_offset_keyed_resume(tmp_path):
         producer.close()
     finally:
         stop_publishing.set()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if broker_proc.poll() is None:
+            broker_proc.kill()
+        tp.reset_tcp_clients()
+
+
+def _fleet_status_json(replica_urls: "list[str]") -> dict:
+    """Run the REAL `cli fleet-status --format json` as a subprocess and
+    parse its output — zero aggregator exceptions is part of the contract
+    (a down replica is data, not a crash)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "oryx_tpu.cli", "fleet-status",
+         "--replicas", ",".join(replica_urls), "--format", "json",
+         "--timeout", "10"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120, cwd=os.getcwd(),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    return json.loads(proc.stdout)
+
+
+def test_fleet_observability_slo_burn_blackbox_and_status(tmp_path):
+    """ISSUE 13 acceptance e2e: a 3-replica fleet under traffic —
+
+    * `cli fleet-status` shows a merged view whose summed request
+      counters equal the exact traffic the test generated;
+    * an armed ``serving.request`` fault schedule on ONE replica drives
+      that replica's fast-window burn rate far past 1 with
+      ``oryx_slo_alert_active`` firing, and the alert edge appears in its
+      ``/debug/bundle``;
+    * ``kill -9``ing it leaves a flight-recorder dump on disk (the
+      periodic tick — no signal ever fires), flips it to down in the
+      fleet table with ZERO aggregator exceptions, and the survivors
+      stay green."""
+    broker_port = ioutils.choose_free_port()
+    broker_dir = tmp_path / "broker"
+    fleet_dir = tmp_path / "fleet"
+    dump_dir = tmp_path / "blackbox"
+    fleet_dir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ORYX_FLEET_DIR=str(fleet_dir))
+    broker_url = f"tcp://127.0.0.1:{broker_port}"
+    http_ports = [ioutils.choose_free_port() for _ in range(N_REPLICAS)]
+    urls = [f"127.0.0.1:{p}" for p in http_ports]
+    rids = [f"obs-r{i}" for i in range(N_REPLICAS)]
+    victim_i = 1
+    procs: dict = {}
+
+    def spawn_quiet(cmd: list) -> subprocess.Popen:
+        # DEVNULL: the injected 500s log one traceback each — an undrained
+        # PIPE would freeze a replica mid-write (the SPOF drill's lesson)
+        return subprocess.Popen(
+            cmd, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT, cwd=os.getcwd(),
+        )
+
+    blackbox_conf = f"""blackbox {{
+    dump-dir = "{dump_dir}"
+    dump-interval-sec = 1
+    dump-min-interval-sec = 0
+  }}"""
+    victim_conf = blackbox_conf + """
+  faults {
+    enabled = true
+    spec = "serving.request=rate:0.6"
+    seed = 13
+  }"""
+
+    broker_proc = spawn_quiet(
+        [sys.executable, "-m", "oryx_tpu.cli", "broker",
+         "--port", str(broker_port), "--dir", str(broker_dir)],
+    )
+    try:
+        _wait_tcp(broker_port)
+        tp.reset_tcp_clients()
+        client = tp.get_broker(broker_url)
+        client.create_topic(UPDATE_TOPIC)
+        client.create_topic("OryxInput")
+        producer = tp.TopicProducerImpl(broker_url, UPDATE_TOPIC)
+        for seq in range(1, 4):  # a few generations so /fleet/state is 200
+            producer.send("GEN", json.dumps(
+                {"seq": seq, "words": {"gen": seq}}
+            ))
+
+        for i, (rid, port) in enumerate(zip(rids, http_ports)):
+            procs[rid] = spawn_quiet(
+                [sys.executable, "-m", "oryx_tpu.cli", "serving",
+                 "--conf", _replica_conf(
+                     tmp_path, rid, port, broker_url,
+                     extra=victim_conf if i == victim_i else blackbox_conf,
+                 )],
+            )
+        for port in http_ports:
+            _wait_ready(port)
+
+        # known traffic: exactly N_REQ /fleet/state requests per replica
+        # (the victim answers ~60% of its share with injected 500s)
+        N_REQ = 80
+        status_counts: dict[str, int] = {}
+        for port in http_ports:
+            with httpx.Client(
+                base_url=f"http://127.0.0.1:{port}", timeout=30
+            ) as c:
+                for _ in range(N_REQ):
+                    r = c.get("/fleet/state")
+                    status_counts[str(r.status_code)] = (
+                        status_counts.get(str(r.status_code), 0) + 1
+                    )
+        assert status_counts.get("200", 0) > 0
+        assert status_counts.get("500", 0) > 0, (
+            "fault schedule never fired", status_counts
+        )
+
+        # scrape the victim twice, past the engine's 0.5s evaluation memo:
+        # the periodic blackbox dumper also evaluates, and a first scrape
+        # landing within the memo window could render a pre-traffic result
+        # (a real scraper's 15s cadence never notices; this assertion
+        # must). With a 0.1% budget and ~60% errors the fast-window burn
+        # is ~600.
+        victim_base = f"http://127.0.0.1:{http_ports[victim_i]}"
+        with httpx.Client(base_url=victim_base, timeout=30) as c:
+            c.get("/metrics")
+            time.sleep(0.6)
+            text = c.get("/metrics").text
+            burn = next(
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("oryx_slo_burn_rate")
+                and 'window="5m"' in line
+            )
+            assert burn > 1.0, f"victim fast-window burn rate {burn}"
+            alert = next(
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("oryx_slo_alert_active")
+                and 'severity="page"' in line
+            )
+            assert alert == 1.0, "page alert did not fire on the victim"
+            # the probe body carries the alert list (informational)
+            readyz = c.get("/readyz")
+            assert readyz.status_code == 200  # alerts never flip readiness
+            assert readyz.json()["slo_alerts"], readyz.text
+            # the alert EDGE is in the victim's flight recorder, with the
+            # injected-fault evidence in the bundled metrics snapshot
+            bundle = c.get("/debug/bundle").json()
+            edges = [e for e in bundle["events"]
+                     if e["kind"] == "slo.alert" and e.get("active")]
+            assert edges and edges[-1]["slo"] == "availability"
+            injected = bundle["metrics"].get(
+                "oryx_faults_injected_total", {}
+            ).get('site="serving.request"', 0)
+            assert injected > 0
+
+        # merged fleet view: summed request counters equal the exact
+        # traffic this test generated, per status class
+        doc = _fleet_status_json(urls)
+        counters = doc["fleet"]["counters"]["oryx_serving_requests_total"]
+        by_status: dict[str, float] = {}
+        total = 0.0
+        for labels, value in counters.items():
+            if 'route="/fleet/state"' not in labels:
+                continue
+            total += value
+            status = labels.split('status="')[1].split('"')[0]
+            by_status[status] = by_status.get(status, 0.0) + value
+        assert total == N_REQ * N_REPLICAS, (total, counters)
+        assert by_status == {
+            k: float(v) for k, v in status_counts.items()
+        }, (by_status, status_counts)
+        victim_row = next(
+            r for r in doc["table"]
+            if r["replica"] == urls[victim_i]
+        )
+        assert victim_row["slo_alerts"] >= 1
+        assert victim_row["worst_burn_rate"] > 1.0
+
+        # kill -9 the victim: the periodic flight-recorder tick already
+        # left dumps on disk — a dead replica leaves evidence
+        procs[rids[victim_i]].send_signal(signal.SIGKILL)
+        assert procs[rids[victim_i]].wait(timeout=10) == -signal.SIGKILL
+        victim_dumps = sorted(
+            f for f in os.listdir(dump_dir)
+            if f.startswith(f"blackbox-{rids[victim_i]}-")
+        )
+        assert victim_dumps, sorted(os.listdir(dump_dir))
+        last = json.loads((dump_dir / victim_dumps[-1]).read_text())
+        assert last["oryx_id"] == rids[victim_i]
+        assert "metrics" in last and "events" in last
+
+        # the fleet table flips the victim to down — no exception, and
+        # the survivors stay green
+        doc = _fleet_status_json(urls)
+        rows = {r["replica"]: r for r in doc["table"]
+                if r["replica"] != "FLEET"}
+        assert rows[urls[victim_i]]["up"] is False
+        assert rows[urls[victim_i]]["error"]
+        for i, url in enumerate(urls):
+            if i != victim_i:
+                assert rows[url]["up"] is True and rows[url]["ready"] is True
+        fleet_row = next(r for r in doc["table"] if r["replica"] == "FLEET")
+        assert fleet_row["n_up"] == N_REPLICAS - 1
+
+        for i, rid in enumerate(rids):
+            if i != victim_i:
+                procs[rid].send_signal(signal.SIGTERM)
+                # exit code 0, not just "exited": the chained SIGTERM dump
+                # handler must hand control back to the cli's clean exit
+                assert procs[rid].wait(timeout=20) == 0, rid
+        producer.close()
+    finally:
         for p in procs.values():
             if p.poll() is None:
                 p.kill()

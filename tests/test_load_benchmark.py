@@ -235,6 +235,96 @@ def test_sanitizer_overhead_within_five_percent_of_smoke_call():
     )
 
 
+def test_slo_and_blackbox_overhead_within_one_percent_of_smoke_call():
+    """The observability layer's cost must stay ≤1% of a smoke device call
+    (ISSUE 13 satellite), measured the deterministic per-event-probe way
+    the span and sanitizer gates are: isolate the per-operation cost
+    (min of 3 probe windows — the true cost is the floor; a scheduler
+    stall must not read as overhead) and compare against the measured mean
+    device call, instead of a noise-drowned two-window qps comparison.
+
+    Two operations are gated: one flight-recorder event append (the
+    resilience sites' hot-path hook) and one full SLO evaluation over the
+    live registry (paid once per scrape, off the request path — gated to
+    the same bound anyway so a scrape can never stall a replica)."""
+    from oryx_tpu.common import blackbox
+    from oryx_tpu.common import metrics as metrics_mod
+    from oryx_tpu.common import slo
+    from oryx_tpu.models.als.serving import ALSServingModel
+
+    rng = np.random.default_rng(0)
+    items, features, how_many, batch = 5_000, 16, 5, 128
+    model = ALSServingModel(features, implicit=True)
+    model.bulk_load_items(
+        [f"i{i}" for i in range(items)],
+        rng.standard_normal((items, features)).astype(np.float32),
+    )
+    queries = rng.standard_normal((512, features)).astype(np.float32)
+    _ = model.top_n_batch(queries[:batch], how_many)  # warm-up/compile
+
+    n_calls = 20
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        model.top_n_batch(queries[(i * batch) % 384:][:batch], how_many)
+    mean_call = (time.perf_counter() - t0) / n_calls
+
+    # (1) per-append cost of the bounded event ring, throttle path included
+    n_probe = 5_000
+    append_cost = float("inf")
+    for _ in range(3):
+        t1 = time.perf_counter()
+        for i in range(n_probe):
+            blackbox.record_event(
+                "overhead.probe", throttle_sec=0.0, site="probe", n=i
+            )
+        append_cost = min(
+            append_cost, (time.perf_counter() - t1) / n_probe
+        )
+    assert append_cost <= 0.01 * mean_call, (
+        f"blackbox append costs {append_cost / mean_call:.2%} of a device "
+        f"call ({append_cost * 1e6:.1f}µs vs {mean_call * 1e3:.2f}ms)"
+    )
+
+    # (2) SLO evaluation, accounted the sanitizer-gate way: per-event cost
+    # × events per device call. Evaluations are scrape-driven and MEMOIZED
+    # to at most one per min_eval_interval_sec (0.5 s — pinned by
+    # tests/test_slo.py::test_memoized_evaluation_is_one_pass_per_scrape),
+    # so the per-call share under continuous scraping is
+    # eval_cost × mean_call / interval. Gate that ≤1%, plus an absolute
+    # guard (≤1 ms) so a pathological evaluation regression trips even
+    # though the amortized bound is generous.
+    registry = metrics_mod.default_registry()
+    eng = slo.SloEngine(
+        [
+            slo.Objective("availability", 99.9, 3600.0,
+                          slo._availability_reader(registry)),
+            slo.Objective("latency", 99.0, 3600.0,
+                          slo._latency_reader(registry, 500.0)),
+        ],
+        min_eval_interval_sec=0.5,
+    )
+    for _ in range(10):
+        eng.evaluate(force=True)  # warm the sample arrays to steady state
+    n_evals = 300
+    eval_cost = float("inf")
+    for _ in range(3):
+        t1 = time.perf_counter()
+        for _ in range(n_evals):
+            eng.evaluate(force=True)
+        eval_cost = min(eval_cost, (time.perf_counter() - t1) / n_evals)
+    evals_per_call = mean_call / eng.min_eval_interval_sec
+    amortized = eval_cost * evals_per_call
+    assert amortized <= 0.01 * mean_call, (
+        f"SLO evaluation costs {amortized / mean_call:.3%} of a device "
+        f"call amortized ({eval_cost * 1e6:.1f}µs per evaluation, at most "
+        f"one per {eng.min_eval_interval_sec}s)"
+    )
+    assert eval_cost <= 1e-3, (
+        f"one SLO evaluation took {eval_cost * 1e6:.0f}µs — the scrape "
+        f"handler budget is blown regardless of amortization"
+    )
+
+
 @pytest.mark.no_sanitize
 def test_transport_microbench_tcp_wakeup_beats_file_poll():
     """Always-on trimmed `bench.py --transport`: the tcp broker's

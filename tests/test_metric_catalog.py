@@ -1,0 +1,93 @@
+"""Metric-name drift gate (ISSUE 13 satellite), in the spirit of the
+config-key-drift checker: every ``oryx_*`` metric registered in code must
+appear in the docs/observability.md catalog, and every metric name the
+catalog mentions must exist in code — the catalog went three PRs between
+refreshes before this gate existed.
+
+Detection is AST-based (literal first arguments of ``counter``/``gauge``/
+``histogram`` registrations anywhere under ``oryx_tpu/``), so the gate
+needs no imports and no registry state; the docs side is a token scan of
+the catalog file."""
+
+import ast
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+#: Names the docs legitimately mention that are not registry registrations:
+#: ``oryx_fleet_replica_up`` is minted by the federation RENDERER (it
+#: describes scrape targets, not this process), and ``oryx_tpu`` is the
+#: package name, which shares the prefix.
+DOC_ONLY_ALLOWED = {"oryx_fleet_replica_up", "oryx_tpu"}
+
+_NAME_RE = re.compile(r"\boryx_[a-z0-9_]+")
+
+
+def _registered_names() -> dict:
+    """{metric name: (relpath, kind)} for every literal registration."""
+    out: dict = {}
+    for root, dirs, files in os.walk(os.path.join(REPO, "oryx_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge", "histogram")
+                    and node.args
+                ):
+                    continue
+                arg = node.args[0]
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("oryx_")
+                ):
+                    out[arg.value] = (
+                        os.path.relpath(path, REPO), node.func.attr
+                    )
+    return out
+
+
+def test_every_registered_metric_is_cataloged():
+    registered = _registered_names()
+    assert registered, "AST scan found no registrations — scanner broken"
+    with open(DOC, encoding="utf-8") as fh:
+        doc_names = set(_NAME_RE.findall(fh.read()))
+    missing = {
+        name: where for name, where in registered.items()
+        if name not in doc_names
+    }
+    assert not missing, (
+        "metric(s) registered in code but absent from the "
+        "docs/observability.md catalog — add a row:\n" + "\n".join(
+            f"  {name}  (registered in {path} as {kind})"
+            for name, (path, kind) in sorted(missing.items())
+        )
+    )
+
+
+def test_every_cataloged_metric_exists_in_code():
+    registered = _registered_names()
+    allowed = set(registered) | DOC_ONLY_ALLOWED
+    # exposition derives _bucket/_sum/_count sample names from histograms,
+    # and the docs may legitimately name those samples
+    for name, (_path, kind) in registered.items():
+        if kind == "histogram":
+            allowed |= {f"{name}_bucket", f"{name}_sum", f"{name}_count"}
+    with open(DOC, encoding="utf-8") as fh:
+        doc_names = set(_NAME_RE.findall(fh.read()))
+    stale = doc_names - allowed
+    assert not stale, (
+        "docs/observability.md names metric(s) no code registers — fossil "
+        "of a rename, fix the catalog:\n" + "\n".join(
+            f"  {name}" for name in sorted(stale)
+        )
+    )

@@ -652,6 +652,14 @@ def test_shed_path_returns_503_with_retry_after():
     assert _counter("oryx_shed_requests_total") - shed_before == len(shed)
     # the accepted requests all completed correctly
     assert all(len(r.json()) == 10 for r in responses if r.status_code == 200)
+    # the overload left throttled flight-recorder evidence: >=1 shed event
+    # (the burst coalesces into one event carrying a suppressed count)
+    # with every shed accounted between its ring slot + suppressions
+    from oryx_tpu.common import blackbox
+
+    shed_events = [e for e in blackbox.events() if e["kind"] == "shed"]
+    assert shed_events and shed_events[-1]["severity"] == "warning"
+    assert shed_events[-1]["max_queue_depth"] == 1
 
 
 def test_request_deadline_returns_504_with_partial_trace_id():

@@ -1,0 +1,376 @@
+"""SLO burn-rate engine (common/slo.py): window math, multi-window alert
+logic, edge events, gauge wiring, and the /readyz alert list."""
+
+import time
+
+import pytest
+
+from oryx_tpu.common import blackbox
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import slo
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeCounter:
+    """Cumulative (good, total) source the tests drive by hand."""
+
+    def __init__(self):
+        self.good = 0.0
+        self.total = 0.0
+
+    def add(self, good: float, bad: float = 0.0) -> None:
+        self.good += good
+        self.total += good + bad
+
+    def read(self) -> tuple:
+        return self.good, self.total
+
+
+def _engine(counter: FakeCounter, clock: FakeClock,
+            objective_pct: float = 99.0, **kw) -> slo.SloEngine:
+    obj = slo.Objective("availability", objective_pct, 3600.0, counter.read)
+    kw.setdefault("min_events", 1)
+    kw.setdefault("min_eval_interval_sec", 0.0)
+    return slo.SloEngine([obj], clock=clock, **kw)
+
+
+def test_burn_rate_is_error_rate_over_budget():
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock)  # budget = 1%
+    eng.evaluate()  # baseline sample at t0
+    counter.add(good=90, bad=10)  # 10% errors
+    clock.advance(10)
+    status = eng.evaluate()["availability"]
+    # 10% error rate / 1% budget = burn 10, on every window (history is
+    # younger than all of them, so each covers the whole life)
+    for label in ("5m", "1h", "30m", "6h"):
+        assert status["burn_rate"][label] == pytest.approx(10.0)
+
+
+def test_short_window_recovers_while_long_window_remembers():
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock)
+    eng.evaluate()
+    counter.add(good=0, bad=100)  # a total outage...
+    clock.advance(30)
+    eng.evaluate()
+    # ...that ended: 40 minutes of clean traffic follow, sampled often
+    # enough that every window has a base sample where it needs one
+    for _ in range(40):
+        counter.add(good=100)
+        clock.advance(60)
+        eng.evaluate()
+    status = eng.evaluate()["availability"]
+    # the 5m window sees only clean traffic; 1h still contains the outage
+    assert status["burn_rate"]["5m"] == pytest.approx(0.0)
+    assert status["burn_rate"]["1h"] > 1.0
+
+
+def test_page_requires_both_fast_windows():
+    """The multi-window AND is the false-alarm killer: a burst that has
+    already left the short window (or never reached the long one) must
+    not page."""
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock, fast_threshold=5.0)
+    eng.evaluate()
+    counter.add(good=0, bad=50)
+    clock.advance(10)
+    status = eng.evaluate()["availability"]
+    assert status["alerts"]["page"] is True  # both windows cover the burst
+    # 10 minutes of light clean traffic: the 5m burn decays under
+    # threshold while the 1h burn (still containing the burst) stays hot
+    # — page must clear (the short window vetoes)
+    for _ in range(10):
+        counter.add(good=50)
+        clock.advance(60)
+        eng.evaluate()
+    status = eng.evaluate()["availability"]
+    assert status["burn_rate"]["1h"] > 5.0
+    assert status["burn_rate"]["5m"] < 5.0
+    assert status["alerts"]["page"] is False
+
+
+def test_burst_before_first_scrape_survives_the_second_scrape():
+    """Errors counted between engine construction and the FIRST scrape
+    must stay visible on the second scrape: the construction-time baseline
+    sample is the window base while history is young (without it, the
+    first evaluation's own sample became the 'oldest' base and the burst
+    vanished — caught live by the verify drive)."""
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock, fast_threshold=2.0)
+    counter.add(good=0, bad=50)  # burst BEFORE any evaluation
+    clock.advance(10)
+    first = eng.evaluate(force=True)["availability"]
+    assert first["burn_rate"]["5m"] > 2.0
+    assert first["alerts"]["page"] is True
+    clock.advance(1.0)  # a second scrape right after, no new traffic
+    second = eng.evaluate(force=True)["availability"]
+    assert second["burn_rate"]["5m"] > 2.0, second
+    assert second["alerts"]["page"] is True
+    # the alert decays on WINDOW time (5m after the burst), not on scrape
+    # cadence
+    clock.advance(400)
+    eng.evaluate(force=True)
+    clock.advance(10)
+    third = eng.evaluate(force=True)["availability"]
+    assert third["burn_rate"]["5m"] == 0.0
+    assert third["alerts"]["page"] is False
+
+
+def test_min_events_guards_quiet_replicas():
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock, min_events=20)
+    eng.evaluate()
+    counter.add(good=0, bad=5)  # 5 failures on a quiet replica
+    clock.advance(10)
+    status = eng.evaluate()["availability"]
+    assert status["burn_rate"]["5m"] == 0.0
+    assert not any(status["alerts"].values())
+
+
+def test_budget_remaining_decreases_and_clamps():
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock)  # 1% budget over 3600s
+    eng.evaluate()
+    counter.add(good=990, bad=10)  # exactly the whole budget
+    clock.advance(10)
+    status = eng.evaluate()["availability"]
+    assert status["budget_remaining"] == pytest.approx(0.0, abs=1e-9)
+    counter.add(good=0, bad=100)  # far past it: clamps at 0
+    clock.advance(10)
+    assert eng.evaluate()["availability"]["budget_remaining"] == 0.0
+
+
+def test_alert_edges_recorded_in_flight_recorder():
+    blackbox.reset_for_tests()
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock, fast_threshold=2.0)
+    eng.evaluate()
+    counter.add(good=0, bad=100)
+    clock.advance(5)
+    eng.evaluate()
+    rising = [e for e in blackbox.events()
+              if e["kind"] == "slo.alert" and e.get("active")]
+    assert rising and all(e["slo"] == "availability" for e in rising)
+    assert any(e["alert_severity"] == "page" for e in rising)
+    # recovery clears it with a falling edge (one event per edge, none in
+    # between)
+    for _ in range(400):
+        counter.add(good=10_000)
+        clock.advance(60)
+        eng.evaluate()
+    falling = [e for e in blackbox.events()
+               if e["kind"] == "slo.alert" and not e.get("active")]
+    assert falling
+    all_edges = [e for e in blackbox.events() if e["kind"] == "slo.alert"]
+    assert len(all_edges) <= 4  # page+ticket rising/falling at most
+    blackbox.reset_for_tests()
+
+
+def test_latency_reader_snaps_threshold_to_bucket_edge():
+    registry = metrics_mod.MetricsRegistry()
+    hist = registry.histogram(
+        "oryx_serving_request_latency_seconds", "test", ("route",),
+        buckets=(0.1, 0.5, 1.0),
+    )
+    for _ in range(8):
+        hist.labels("/r").observe(0.05)  # under threshold
+    for _ in range(2):
+        hist.labels("/r").observe(0.7)  # over
+    hist.labels("/metrics").observe(5.0)  # ops route: excluded entirely
+    read = slo._latency_reader(registry, threshold_ms=500.0)
+    good, total = read()
+    assert (good, total) == (8.0, 10.0)
+    # a threshold between edges snaps UP to the next edge (0.3s -> 0.5s)
+    read2 = slo._latency_reader(registry, threshold_ms=300.0)
+    assert read2() == (8.0, 10.0)
+
+
+def test_availability_reader_excludes_ops_routes_and_cancelled():
+    registry = metrics_mod.MetricsRegistry()
+    counter = registry.counter(
+        "oryx_serving_requests_total", "test", ("route", "method", "status"),
+    )
+    counter.labels("/recommend/{id}", "GET", "200").inc(90)
+    counter.labels("/recommend/{id}", "GET", "500").inc(10)
+    counter.labels("/recommend/{id}", "GET", "cancelled").inc(5)
+    counter.labels("/metrics", "GET", "500").inc(50)  # ops: excluded
+    counter.labels("/api/readyz", "GET", "503").inc(50)  # prefixed ops too
+    good, total = slo._availability_reader(registry)()
+    assert (good, total) == (90.0, 100.0)
+
+
+def test_configure_defaults_and_gauges_render():
+    eng = slo.configure(cfg.get_default())
+    assert [o.name for o in eng.objectives] == ["availability"]
+    text = metrics_mod.default_registry().render()
+    assert 'oryx_slo_burn_rate{slo="availability",window="5m"}' in text
+    assert 'oryx_slo_error_budget_remaining{slo="availability"}' in text
+    assert 'oryx_slo_alert_active{slo="availability",severity="page"}' in text
+
+
+def test_configure_latency_objective_and_disable():
+    config = cfg.overlay_on(
+        {"oryx.slo.latency.enabled": True,
+         "oryx.slo.latency.threshold-ms": 250},
+        cfg.get_default(),
+    )
+    eng = slo.configure(config)
+    assert [o.name for o in eng.objectives] == ["availability", "latency"]
+    # shrinking the objective set quiets the DROPPED objective's gauges:
+    # the old engine must not keep evaluating latency through its stale
+    # callbacks (nor be pinned alive by them)
+    eng2 = slo.configure(cfg.get_default())
+    assert [o.name for o in eng2.objectives] == ["availability"]
+    text = metrics_mod.default_registry().render()
+    latency_burns = [
+        line for line in text.splitlines()
+        if line.startswith("oryx_slo_burn_rate") and 'slo="latency"' in line
+    ]
+    assert latency_burns and all(
+        line.rsplit(" ", 1)[1] == "0" for line in latency_burns
+    ), latency_burns
+    off = cfg.overlay_on({"oryx.slo.enabled": False}, cfg.get_default())
+    assert slo.configure(off) is None
+    assert slo.status() == {}
+    assert slo.active_alerts() == []
+    # fully disabled: every slo gauge child is quiet, none still routes
+    # into a superseded engine
+    text = metrics_mod.default_registry().render()
+    for line in text.splitlines():
+        if line.startswith(("oryx_slo_burn_rate", "oryx_slo_alert_active")):
+            assert line.rsplit(" ", 1)[1] == "0", line
+    # restore the default engine for the rest of the suite
+    slo.configure(cfg.get_default())
+
+
+def test_active_alerts_shape():
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock, fast_threshold=1.0)
+    eng.evaluate()
+    counter.add(good=0, bad=100)
+    clock.advance(5)
+    alerts = eng.active_alerts()
+    assert alerts and alerts[0]["slo"] == "availability"
+    assert alerts[0]["severity"] == "page"
+    assert "burn_rate" in alerts[0] and "budget_remaining" in alerts[0]
+
+
+def test_sample_history_is_count_bounded_under_fast_probing():
+    """A 1s probe cadence against a 24h budget window must not retain a
+    day of samples: past MAX_SAMPLES the oldest half decimates 2:1 and
+    windowing stays correct (bases snap slightly older, never younger)."""
+    clock = FakeClock()
+    counter = FakeCounter()
+    eng = _engine(counter, clock)
+    eng.MAX_SAMPLES = 64
+    for _ in range(1000):
+        counter.add(good=10)
+        clock.advance(1.0)
+        eng.evaluate(force=True)
+    assert len(eng._times) <= 64
+    assert eng._times == sorted(eng._times)
+    assert len(eng._times) == len(eng._readings)
+    # windows still evaluate sanely over the decimated history (the base
+    # may snap OLDER than 5m — decimation coarsens old granularity — so
+    # the burst must dominate even a generously-dated window)
+    counter.add(good=0, bad=2000)
+    clock.advance(1.0)
+    status = eng.evaluate(force=True)["availability"]
+    assert status["burn_rate"]["5m"] > 1.0
+
+
+def test_memoized_evaluation_is_one_pass_per_scrape():
+    clock = FakeClock()
+    calls = {"n": 0}
+
+    def reader():
+        calls["n"] += 1
+        return 0.0, 0.0
+
+    obj = slo.Objective("availability", 99.9, 3600.0, reader)
+    eng = slo.SloEngine([obj], clock=clock, min_eval_interval_sec=0.5)
+    baseline = calls["n"]  # construction seeds one baseline read
+    for _ in range(25):  # one scrape renders many gauge children
+        eng.evaluate()
+    assert calls["n"] == baseline + 1
+    clock.advance(1.0)
+    eng.evaluate()
+    assert calls["n"] == baseline + 2
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        slo.Objective("x", 0.0, 60.0, lambda: (0, 0))
+    with pytest.raises(ValueError):
+        slo.Objective("x", 100.0, 60.0, lambda: (0, 0))
+
+
+def test_window_labels():
+    assert slo._window_label(300) == "5m"
+    assert slo._window_label(3600) == "1h"
+    assert slo._window_label(21600) == "6h"
+    assert slo._window_label(45) == "45s"
+
+
+def test_readyz_body_carries_alert_list():
+    """/readyz embeds the active-alert list (informational: alerts never
+    flip readiness)."""
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from oryx_tpu.serving.app import make_app
+
+    class _Model:
+        def get_fraction_loaded(self):
+            return 1.0
+
+    class _Manager:
+        rescorer_provider = None
+
+        def get_model(self):
+            return _Model()
+
+        def get_staged_model(self):
+            return None
+
+        def is_read_only(self):
+            return True
+
+    config = cfg.get_default()
+    app = make_app(config, _Manager())
+
+    async def run():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/readyz")
+            body = await resp.json()
+            assert resp.status == 200
+            assert "slo_alerts" in body
+            assert isinstance(body["slo_alerts"], list)
+        finally:
+            await client.close()
+
+    asyncio.run(run())

@@ -898,6 +898,12 @@ def test_corrupt_log_bitflip_and_torn_tail_exactly_once(tmp_path, scheme):
         assert _metric(
             "oryx_broker_torn_tail_records_total", 'topic="T"'
         ) == torn_before + 1
+        # recovery leaves flight-recorder evidence (byte count included)
+        from oryx_tpu.common import blackbox
+
+        torn_events = [e for e in blackbox.events()
+                       if e["kind"] == "broker.torn_tail" and e["topic"] == "T"]
+        assert torn_events and torn_events[-1]["truncated_bytes"] > 0
         it = tp.ConsumeDataIterator(broker, "T", "earliest")
         got = [next(it).key for _ in range(5)]
         assert got == ["0", "1", "3", "4", "5"]  # exactly the bad one skipped
