@@ -77,8 +77,11 @@ def area_under_curve(x, y, train: RatingBatch, test: RatingBatch, negatives_per_
     rows = jnp.asarray(np.asarray(pos_rows, dtype=np.int32))
     pc = jnp.asarray(np.asarray(pos_cols, dtype=np.int32))
     nc = jnp.asarray(np.asarray(neg_cols, dtype=np.int32))
-    pos_scores = np.asarray(_pair_scores(x, y, rows, pc))
-    neg_scores = np.asarray(_pair_scores(x, y, rows, nc))
+    # one explicit batched fetch for both score sets (two piecemeal
+    # np.asarray calls were two blocking transfers)
+    pos_scores, neg_scores = jax.device_get(
+        (_pair_scores(x, y, rows, pc), _pair_scores(x, y, rows, nc))
+    )
     correct = (pos_scores > neg_scores).astype(np.float64) + 0.5 * (pos_scores == neg_scores)
     # mean of per-user AUC (not pooled) — reference averages per user
     df = {}

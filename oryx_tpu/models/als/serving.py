@@ -218,6 +218,11 @@ def _sharded_top_k_fn(mesh, axis: str, k: int, k_final: int, n_real: int,
 
     @jax.jit
     def fn(mat, qs, excl, lut, buckets):
+        # the replicated P(None, None) operands here are BATCH-shaped
+        # (queries/exclusions/lut: B·k, B·E, B·buckets) — a deliberate
+        # small broadcast, which the replicated-collective checker keeps
+        # quiet on because none of them is data-gathered like a factor
+        # table; Y (the model-scaled operand) is the sharded one
         vals, idx = shard_map(
             local,
             mesh=mesh,

@@ -771,6 +771,11 @@ def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk,
         out = jax.lax.map(one, (srows, scols, svals, slens))
         return out.reshape(-1, features)
 
+    # in_specs[0] = P(): the full opposite factor y replicates into every
+    # half-iteration (~N·k·4 B all-gathered per call) — the known ROADMAP
+    # item-5(a) scaling bug, flagged by the replicated-collective checker
+    # and accepted in conf/analyze-baseline.json until the routed-mesh fix
+    # (ship only the factor rows each block needs) lands
     specs = dict(
         mesh=mesh,
         in_specs=(P(), P(row_axis), P(row_axis), P(row_axis), P(row_axis),

@@ -182,8 +182,11 @@ def kmeans_train(
         centers, counts, costs = jax.vmap(
             lambda kk: _kmeans_single_run(kk, pts, weights, k, iterations, init)
         )(keys)
-    best = int(jnp.argmin(costs))
+    # pick the winner on device and fetch both result arrays in ONE
+    # explicit transfer (argmin + two np.asarray calls were three syncs)
+    best = jnp.argmin(costs)
+    centers_np, counts_np = jax.device_get((centers[best], counts[best]))
     return (
-        np.asarray(centers[best], dtype=np.float64),
-        np.asarray(counts[best], dtype=np.int64),
+        centers_np.astype(np.float64),
+        counts_np.astype(np.int64),
     )

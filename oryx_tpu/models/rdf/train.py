@@ -363,9 +363,13 @@ def _grow_tree(
             task=task,
             impurity=impurity,
         )
-        gain = np.asarray(gain)
-        totals_np = np.asarray(totals)
-        cl_np, cr_np = np.asarray(cl), np.asarray(cr)
+        # ONE explicit batched fetch per level instead of six piecemeal
+        # np.asarray syncs — the split decision is host control flow by
+        # design (level-wise growth), but it only needs one device
+        # round-trip to make it
+        gain, feat_np, left_mask_np, cl_np, cr_np, totals_np = jax.device_get(
+            (gain, feat, left_mask, cl, cr, totals)
+        )
         # a node splits if it found positive gain, more depth is allowed, and
         # the reference's pre-prune knobs pass: per-example gain at least
         # min-info-gain-nats, both children at least min-node-size examples
@@ -383,11 +387,11 @@ def _grow_tree(
         levels.append(
             dict(
                 split=split,
-                feature=np.asarray(feat),
-                left_mask=np.asarray(left_mask),
-                count_l=np.asarray(cl),
-                count_r=np.asarray(cr),
-                totals=np.asarray(totals),
+                feature=feat_np,
+                left_mask=left_mask_np,
+                count_l=cl_np,
+                count_r=cr_np,
+                totals=totals_np,
             )
         )
         if not split.any():

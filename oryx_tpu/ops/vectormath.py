@@ -37,6 +37,20 @@ def cosine_similarity(x, y, norm_y=None):
     return jnp.dot(x, y) / (jnp.linalg.norm(x) * ny)
 
 
+def cosine_similarities(rows, y, norm_y=None) -> np.ndarray:
+    """Cosine similarity of EVERY row of ``rows`` against ``y`` in one
+    device call, returned as a host float32 array. The batched form of
+    :func:`cosine_similarity` for the similarity/because endpoints: a
+    per-pair loop costs one dispatch plus one blocking device→host sync
+    PER ITEM (the host-device-transfer checker's per-element class), where
+    this is one matvec and one transfer for the whole list."""
+    rows = jnp.asarray(np.asarray(rows, dtype=np.float32))
+    y = jnp.asarray(y)
+    ny = jnp.linalg.norm(y) if norm_y is None else norm_y
+    sims = (rows @ y) / (jnp.linalg.norm(rows, axis=1) * ny)
+    return np.asarray(sims, dtype=np.float32)
+
+
 @jax.jit
 def _gramian(x):
     xf = x.astype(jnp.float32)

@@ -853,9 +853,34 @@ class ServingLayer:
             # construction) after a bounded-exponential delay, while the
             # HTTP side keeps answering from the current in-memory model.
             restarts = 0
+            need_rebuild = False
             while not self._stopped.is_set():
                 attempt_started = time.monotonic()
                 try:
+                    if need_rebuild:
+                        # the rebuild runs INSIDE the supervised try: the
+                        # iterator constructor performs broker RPCs
+                        # (num_partitions, stored offsets), and a broker
+                        # still down at restart time used to raise out of
+                        # the except handler below and kill this thread
+                        # permanently — a replica that serves forever but
+                        # never consumes again (the fleet SPOF drill's
+                        # "never drained" stall)
+                        ioutils.close_quietly(self._update_iterator)
+                        # committed mode restarts from the stored positions
+                        # (offset-keyed resume); earliest replays in full
+                        self._update_iterator, self._metered_updates = (
+                            _new_update_pipeline()
+                        )
+                        need_rebuild = False
+                        if self._stopped.is_set():
+                            # close() raced the rebuild: it closed the OLD
+                            # iterator before the assignment above landed,
+                            # so this fresh one is ours to close — without
+                            # this re-check the consumer would block in
+                            # consume() on an iterator nothing ever closes
+                            ioutils.close_quietly(self._update_iterator)
+                            return
                     self.manager.consume(self._metered_updates)
                     return  # iterator closed: clean shutdown
                 except Exception as e:  # noqa: BLE001 — supervised
@@ -890,15 +915,14 @@ class ServingLayer:
                     )
                     if self._stopped.wait(delay):
                         return
-                    ioutils.close_quietly(self._update_iterator)
-                    # committed mode restarts from the stored positions
-                    # (offset-keyed resume); earliest mode replays in full
-                    self._update_iterator, self._metered_updates = (
-                        _new_update_pipeline()
-                    )
-                    # loop re-checks _stopped before consuming again, so a
-                    # close() racing the rebuild cannot strand a consumer
-                    # blocked on a just-created iterator
+                    need_rebuild = True
+                    # the loop re-checks _stopped before rebuilding, and the
+                    # rebuild re-checks it again after installing the fresh
+                    # iterator (closing it when close() raced) — so a
+                    # close() at any point cannot strand a consumer blocked
+                    # on a just-created iterator; a rebuild that fails
+                    # (broker still down) lands back here with the next
+                    # backoff step instead of ending the thread
 
         self._consumer_thread = threading.Thread(
             target=consume, name="OryxServingLayerUpdateConsumerThread", daemon=True

@@ -225,10 +225,12 @@ async def similarity_to_item(request: web.Request) -> web.Response:
         vecs.append(v)
     # the jnp dispatch (and its first-call XLA compile, ~600 ms) must not
     # run on the event loop — the sanitizer's loop-stall watchdog caught
-    # exactly that here; one executor hop covers the whole pair list
+    # exactly that here; one executor hop covers the whole pair list, and
+    # the cosines are batched into ONE device call + one transfer (the
+    # per-pair float() loop was one blocking sync per item)
     sims = await _run(
         request,
-        lambda: [float(vm.cosine_similarity(v, to_vec, norm_to)) for v in vecs],
+        lambda: vm.cosine_similarities(np.stack(vecs), to_vec, norm_to).tolist(),
     )
     return render(request, [id_value(i, s) for i, s in zip(items, sims)])
 
@@ -268,14 +270,14 @@ async def because(request: web.Request) -> web.Response:
         return render(request, [])
     norm = float(np.linalg.norm(item_vec))
     # same loop-stall hazard as similarity_to_item: per-pair jnp dispatch
-    # off the event loop in one hop
-    sims = await _run(
+    # off the event loop in one hop, cosines batched into one device call
+    sim_vals = await _run(
         request,
-        lambda: [
-            (i, float(vm.cosine_similarity(v, item_vec, norm)))
-            for i, v in known_vecs
-        ],
+        lambda: vm.cosine_similarities(
+            np.stack([v for _, v in known_vecs]), item_vec, norm
+        ).tolist(),
     )
+    sims = list(zip((i for i, _ in known_vecs), sim_vals))
     sims.sort(key=lambda t: -t[1])
     return render(request, [id_value(i, s) for i, s in sims[offset:offset + how_many]])
 

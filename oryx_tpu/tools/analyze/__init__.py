@@ -18,11 +18,26 @@ that layer for the failure modes this codebase actually has (VERDICT r5):
   * ``config-key-drift``   — oryx.* keys read but undeclared, or declared but
                              never read
   * ``float64-promotion``  — float64 constants flowing into jitted numerics
+  * ``replicated-collective`` — model-scaled tables entering shard_map/pjit
+                             regions replicated (per-call all-gather priced
+                             in shape symbols)
+  * ``host-device-transfer`` — silent device→host syncs reachable from async
+                             handlers, inside trainer loops, or per-element
+  * ``dtype-widening``     — bf16/int8 values silently promoted to f32 in
+                             jit outside sanctioned rescore/solve sites
 
-Run it as ``python -m oryx_tpu.cli analyze [--format json|text]``; suppress a
-finding inline with ``# analyze: ignore[<checker-id>] -- justification`` or
-in the committed baseline (``conf/analyze-baseline.json``), both of which
-require a justification string.
+The last three ride a shared sharding- and dtype-aware dataflow pass
+(``dataflow.py``: abstract shapes, the int8≤bf16≤f32≤f64 lattice, device
+placement, PartitionSpec parsing), which also powers ``analyze --cost`` —
+a per-jit-program static roofline (FLOPs / HBM bytes / collective bytes as
+shape-symbol polynomials, ``--bind`` to price concrete model shapes).
+
+Run it as ``python -m oryx_tpu.cli analyze [--format json|text|sarif]``;
+suppress a finding inline with ``# analyze: ignore[<checker-id>] --
+justification`` or in the committed baseline
+(``conf/analyze-baseline.json``), both of which require a justification
+string (baseline entries also pin the checker version they were judged
+against).
 """
 
 from oryx_tpu.tools.analyze.core import (  # noqa: F401

@@ -15,6 +15,9 @@ from oryx_tpu.tools.analyze.checkers.float64 import Float64PromotionChecker
 from oryx_tpu.tools.analyze.checkers.logstyle import LogDisciplineChecker
 from oryx_tpu.tools.analyze.checkers.swallowed import SwallowedExceptionChecker
 from oryx_tpu.tools.analyze.checkers.perrowstore import PerRowNdarrayStoreChecker
+from oryx_tpu.tools.analyze.checkers.replicated import ReplicatedCollectiveChecker
+from oryx_tpu.tools.analyze.checkers.hosttransfer import HostDeviceTransferChecker
+from oryx_tpu.tools.analyze.checkers.dtypewidth import DtypeWideningChecker
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -30,4 +33,11 @@ ALL_CHECKERS = (
     LogDisciplineChecker(),
     SwallowedExceptionChecker(),
     PerRowNdarrayStoreChecker(),
+    ReplicatedCollectiveChecker(),
+    HostDeviceTransferChecker(),
+    DtypeWideningChecker(),
 )
+
+#: checker id -> precision version, recorded per baseline entry so a
+#: checker upgrade invalidates stale justifications loudly (core.py).
+CHECKER_VERSIONS = {c.id: getattr(c, "version", 1) for c in ALL_CHECKERS}

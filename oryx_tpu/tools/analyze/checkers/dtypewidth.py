@@ -1,0 +1,162 @@
+"""dtype-widening: narrow device dtypes silently promoted to f32 in jit.
+
+The framework keeps deliberately-narrow device copies — bf16 scoring
+matrices (half the HBM per scan) and int8 quantized factor slabs (a
+quarter) — precisely to stay under the bandwidth roofline. A bf16/int8
+value that silently contracts or mixes at float32 inside a jitted program
+pays f32 traffic anyway while keeping the narrow dtype's rounding error:
+the worst of both. This generalizes ``float64-promotion`` onto real
+dataflow (the dtype lattice ``int8 ≤ bf16 ≤ f32 ≤ f64``) instead of
+literal spotting.
+
+Flagged inside jit scopes: a binary arithmetic op mixing a LOW-dtype value
+(``int8``/``bfloat16`` by ``.astype``/constructor evidence) with a float32
+one, and an einsum/matmul/dot over mixed LOW+f32 operands with NO
+``preferred_element_type``. Sanctioned and silent:
+
+  * ``preferred_element_type=...`` contractions — f32 ACCUMULATION over
+    narrow inputs is the standard TPU matmul recipe, not a widening;
+  * an explicit ``.astype(float32)`` — visible intent, not silent;
+  * scopes whose qualname contains ``rescore`` or ``solve`` — the exact-f32
+    rescore of quantized candidates and the f32 Cholesky/Gauss-Jordan
+    solves widen by design.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import scope_nodes
+from oryx_tpu.tools.analyze.dataflow import (
+    DTYPE_RANK,
+    LOW_DTYPES,
+    LineStateEnv,
+    dtype_of_node,
+)
+
+ID = "dtype-widening"
+
+_SANCTIONED_NAME_PARTS = ("rescore", "solve")
+_CONTRACTION_NAMES = {
+    "jax.numpy.einsum", "jax.numpy.matmul", "jax.numpy.dot",
+    "jax.numpy.tensordot",
+}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.MatMult, ast.Pow)
+#: jnp constructors whose default dtype is float32.
+_F32_DEFAULT_CTORS = {"zeros", "ones", "full", "empty", "zeros_like",
+                      "ones_like", "linspace"}
+
+
+class _DtypeEnv:
+    """Flow-sensitive (per-line) name -> lattice dtype inference for one
+    jit scope, the same discipline as ``dataflow.DeviceFlow``: a name
+    resolves to its dtype just BEFORE the queried line, so the idiomatic
+    compute-wide-then-store-narrow pattern (``acc = acc + w`` ... ``acc =
+    acc.astype(bf16)`` at the end) never retro-flags the earlier pure-f32
+    arithmetic."""
+
+    def __init__(self, fctx, fn_node):
+        self.fctx = fctx
+        self._env = LineStateEnv()
+        stmts = sorted(
+            (n for n in scope_nodes(fctx, fn_node)
+             if isinstance(n, (ast.Assign, ast.AnnAssign))),
+            key=lambda n: n.lineno,
+        )
+        for stmt in stmts:
+            if stmt.value is None:
+                continue
+            dt = self.dtype_of(stmt.value, stmt.lineno)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self._env.record(t.id, stmt.lineno, dt)
+
+    def dtype_of(self, node, line: int) -> "str | None":
+        if isinstance(node, ast.Name):
+            return self._env.state_before(node.id, line)
+        if isinstance(node, ast.Attribute):
+            if node.attr == "T":
+                return self.dtype_of(node.value, line)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.dtype_of(node.value, line)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "astype":
+                if node.args:
+                    return dtype_of_node(self.fctx, node.args[0])
+                return None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return dtype_of_node(self.fctx, kw.value)
+            resolved = self.fctx.resolve(func)
+            if resolved:
+                mod, _, name = resolved.rpartition(".")
+                if mod == "jax.numpy" and name in _F32_DEFAULT_CTORS:
+                    return "float32"
+            return None
+        if isinstance(node, ast.BinOp):
+            lo = self.dtype_of(node.left, line)
+            hi = self.dtype_of(node.right, line)
+            if lo is None or hi is None:
+                return lo or hi
+            return lo if DTYPE_RANK[lo] >= DTYPE_RANK[hi] else hi
+        return None
+
+
+def _mixes_low_and_f32(env: _DtypeEnv, operands, line: int) -> "tuple | None":
+    """(low_expr, low_dtype) when the operand dtypes (as of ``line``) mix a
+    LOW dtype with float32/float64 — the silent-widening signature."""
+    dts = [(op, env.dtype_of(op, line)) for op in operands]
+    low = next(((op, dt) for op, dt in dts if dt in LOW_DTYPES), None)
+    wide = any(dt in ("float32", "float64") for _, dt in dts)
+    return low if (low and wide) else None
+
+
+class DtypeWideningChecker:
+    id = ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        for fctx in project.files:
+            for scope in fctx.jit_scopes.values():
+                low_name = scope.qualname.lower()
+                if any(p in low_name for p in _SANCTIONED_NAME_PARTS):
+                    continue
+                env = _DtypeEnv(fctx, scope.node)
+                for node in scope_nodes(fctx, scope.node):
+                    hit = None
+                    how = None
+                    if isinstance(node, ast.BinOp) and isinstance(
+                        node.op, _ARITH_OPS
+                    ):
+                        hit = _mixes_low_and_f32(
+                            env, [node.left, node.right], node.lineno
+                        )
+                        how = "arithmetic mixing"
+                    elif isinstance(node, ast.Call):
+                        resolved = fctx.resolve(node.func)
+                        if resolved in _CONTRACTION_NAMES and not any(
+                            kw.arg == "preferred_element_type"
+                            for kw in node.keywords
+                        ):
+                            hit = _mixes_low_and_f32(
+                                env, list(node.args), node.lineno
+                            )
+                            how = "a contraction over"
+                    if hit is None:
+                        continue
+                    expr, dt = hit
+                    out.append(fctx.finding(
+                        ID, node,
+                        f"{how} {dt} `{ast.unparse(expr)[:40]}` and float32 "
+                        f"inside jitted `{scope.qualname}` silently widens "
+                        f"to f32 — the narrow copy pays full HBM traffic "
+                        "anyway; widen explicitly (.astype) at a sanctioned "
+                        "rescore/solve site, or keep the op narrow with "
+                        "preferred_element_type accumulation",
+                        symbol=f"{scope.qualname}:{dt}",
+                    ))
+        return out

@@ -1,0 +1,71 @@
+"""replicated-collective: model-scaled tables entering a mesh region
+replicated.
+
+Distributed-ALS routing (MLlib's block layout, arXiv:1505.06807) treats
+per-iteration collective bytes as THE scaling budget: a ``shard_map``/
+``pjit`` input spec'd ``P()`` (or all-``None``) all-gathers the full operand
+to every device on every call. For batch-shaped operands (queries, masks)
+that is the design; for a factor TABLE whose size scales with a model
+dimension (N·k) it is the classic scaling bug — ROADMAP item 5(a)'s
+``train.py`` replicated-``y`` all-gather, invisible to every control-flow
+checker.
+
+The decision rides the dataflow pass (tools/analyze/dataflow.py): an operand
+is *model-scaled* when the wrapped function (or a one-positional-hop callee)
+gathers it by data indices (``y[cs]``, ``jnp.take``) or forms its
+self-Gramian (``y.T @ y``) — the factor-table signature that batch operands
+never show. Closure-captured device arrays enter the region exactly like a
+``P()`` in_spec and are checked the same way. Findings carry the estimated
+per-call all-gather byte polynomial (``y.d0·y.d1·4``), the same expression
+``analyze --cost`` evaluates under ``--bind``.
+"""
+
+from __future__ import annotations
+
+from oryx_tpu.tools.analyze.dataflow import (
+    model_scaled_params,
+    replicated_bytes,
+    replicated_capture_names,
+    shard_regions,
+    _direct_gather_evidence,
+)
+
+ID = "replicated-collective"
+
+
+class ReplicatedCollectiveChecker:
+    id = ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        for region in shard_regions(project):
+            fctx = region.fctx
+            scaled = model_scaled_params(project, fctx, region.wrapped_node)
+            for param in region.replicated:
+                if param not in scaled:
+                    continue
+                est = replicated_bytes(param).render()
+                out.append(fctx.finding(
+                    ID, region.call,
+                    f"replicated `{param}` enters shard_map region "
+                    f"`{region.wrapped_qual}` via an unsharded in_spec: the "
+                    f"full table all-gathers to every device each call "
+                    f"(~{est} B) — ship only the rows each shard needs "
+                    "(routing table) or shard the table",
+                    symbol=f"{region.wrapped_qual}:{param}",
+                ))
+            for name in replicated_capture_names(project, region):
+                if not _direct_gather_evidence(fctx, region.wrapped_node, name):
+                    continue
+                est = replicated_bytes(name).render()
+                out.append(fctx.finding(
+                    ID, region.call,
+                    f"device array `{name}` is closure-captured by shard_map "
+                    f"region `{region.wrapped_qual}`: it enters the traced "
+                    f"program replicated (~{est} B all-gathered per call) "
+                    "with no in_spec line to review — pass it as a sharded "
+                    "argument instead",
+                    symbol=f"{region.wrapped_qual}:capture:{name}",
+                ))
+        return out

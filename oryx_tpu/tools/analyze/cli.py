@@ -69,19 +69,104 @@ def _changed_relpaths(root: str) -> "set[str]":
     return out
 
 
+def _parse_bindings(bind_args: "list[str]") -> dict:
+    out: dict = {}
+    for chunk in bind_args:
+        for pair in chunk.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            sym, sep, value = pair.partition("=")
+            if not sep:
+                print(f"--bind needs SYM=VALUE, got {pair!r}", file=sys.stderr)
+                raise SystemExit(2)
+            try:
+                out[sym.strip()] = float(value)
+            except ValueError:
+                print(f"--bind value for {sym!r} is not numeric: {value!r}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+    return out
+
+
+def _fmt_cost(poly, bindings: dict) -> str:
+    value = poly.evaluate(bindings) if bindings else None
+    if value is not None:
+        return f"{value:,.0f}"
+    return poly.render() if poly else "-"
+
+
+def _cost_main(paths, root, args) -> int:
+    """``analyze --cost``: the static roofline table — per-jit-program
+    FLOPs / HBM bytes / collective bytes from the abstract shapes, to diff
+    in review before anything runs on chip (the static twin of the runtime
+    CostRegistry in common/profiling.py)."""
+    from oryx_tpu.tools.analyze.core import build_project
+    from oryx_tpu.tools.analyze.dataflow import cost_report
+
+    bindings = _parse_bindings(args.bind)
+    project, errors = build_project(paths, root)
+    rows = cost_report(project)
+    if args.format == "json":
+        payload = []
+        for r in rows:
+            entry = {
+                "program": r["program"], "path": r["path"], "line": r["line"],
+            }
+            for field in ("flops", "hbm_bytes", "collective_bytes"):
+                poly = r[field]
+                entry[field] = {
+                    "expr": poly.render(),
+                    "value": poly.evaluate(bindings) if bindings else None,
+                }
+            payload.append(entry)
+        print(json.dumps({"programs": payload, "bindings": bindings,
+                          "parse_errors": errors}, indent=2))
+    else:
+        header = f"{'program':58s} {'flops':>24s} {'hbm_bytes':>24s} {'collective_bytes':>24s}"
+        print(header)
+        print("-" * len(header))
+        for r in rows:
+            print(f"{r['program'][:58]:58s} "
+                  f"{_fmt_cost(r['flops'], bindings)[:24]:>24s} "
+                  f"{_fmt_cost(r['hbm_bytes'], bindings)[:24]:>24s} "
+                  f"{_fmt_cost(r['collective_bytes'], bindings)[:24]:>24s}")
+        print(f"{len(rows)} jit program(s)"
+              + (f", bound: {bindings}" if bindings else ""))
+        for err in errors:
+            print(f"PARSE ERROR: {err}", file=sys.stderr)
+    return 2 if errors else 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="oryx-run analyze",
         description="AST static analysis for JAX/asyncio correctness "
         "(tracer leaks, recompile hazards, blocking-in-async, lock "
         "discipline, lock-order cycles, blocking-under-lock, shared-state "
-        "escapes, config-key drift, float64 promotion)",
+        "escapes, config-key drift, float64 promotion, replicated "
+        "collectives, host-device transfers, dtype widening) plus the "
+        "--cost static roofline",
     )
     parser.add_argument(
         "paths", nargs="*",
         help="files/directories to scan (default: the oryx_tpu package)",
     )
-    parser.add_argument("--format", choices=["text", "json"], default="text")
+    parser.add_argument(
+        "--format", choices=["text", "json", "sarif"], default="text",
+        help="sarif = SARIF 2.1.0 for CI code-scanning annotations",
+    )
+    parser.add_argument(
+        "--cost", action="store_true",
+        help="emit the per-jit-program static cost table (FLOPs / HBM "
+        "bytes / collective bytes as shape-symbol polynomials) instead "
+        "of findings",
+    )
+    parser.add_argument(
+        "--bind", action="append", default=[], metavar="SYM=VALUE",
+        help="bind shape symbols for --cost evaluation (repeatable, "
+        "comma-separable): --bind y.d0=1000000,y.d1=50",
+    )
     parser.add_argument(
         "--baseline", default=None,
         help="baseline JSON of accepted findings "
@@ -113,6 +198,27 @@ def main(argv: "list[str] | None" = None) -> int:
     default_paths, root = _default_paths()
     paths = args.paths or default_paths
     baseline_path = args.baseline or _default_baseline(root)
+    if args.cost:
+        # refuse findings-mode flags instead of silently dropping them: an
+        # operator typing `--cost --changed` would otherwise believe the
+        # table was diff-scoped, and `--cost --update-baseline` would exit
+        # 0 having written nothing
+        bad = [flag for flag, on in (
+            ("--changed", args.changed),
+            ("--update-baseline", args.update_baseline),
+            ("--checker", bool(args.checkers)),
+            ("--baseline", args.baseline is not None),
+            ("--no-baseline", args.no_baseline),
+            ("--format sarif", args.format == "sarif"),
+        ) if on]
+        if bad:
+            print("--cost prices jit programs, not findings; it does not "
+                  f"combine with {', '.join(bad)}", file=sys.stderr)
+            return 2
+        return _cost_main(paths, root, args)
+    if args.bind:
+        print("--bind only applies to --cost", file=sys.stderr)
+        return 2
     only_relpaths = None
     if args.changed:
         if args.update_baseline:
@@ -129,6 +235,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     "findings": [], "counts": {}, "total": 0,
                     "unsuppressed": 0, "suppressed": 0, "parse_errors": [],
                 }, indent=2))
+            elif args.format == "sarif":
+                from oryx_tpu.tools.analyze.core import AnalysisResult
+                from oryx_tpu.tools.analyze.sarif import to_sarif
+
+                print(json.dumps(to_sarif(AnalysisResult([], [])), indent=2))
             else:
                 print("0 finding(s) (no changed .py files)")
             return 0
@@ -148,6 +259,10 @@ def main(argv: "list[str] | None" = None) -> int:
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2))
+    elif args.format == "sarif":
+        from oryx_tpu.tools.analyze.sarif import to_sarif
+
+        print(json.dumps(to_sarif(result), indent=2))
     else:
         for f in result.findings:
             print(f.render())

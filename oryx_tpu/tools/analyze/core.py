@@ -614,27 +614,41 @@ class ProjectContext:
 
 
 def load_baseline(path: str) -> dict:
-    """(checker, path, symbol) -> justification. Empty when absent."""
+    """(checker, path, symbol) -> {justification, version}. Empty when
+    absent. ``version`` defaults to 1 (pre-versioning entries)."""
     if not path or not os.path.exists(path):
         return {}
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     out = {}
     for e in data.get("entries", []):
-        out[(e["checker"], e["path"], e["symbol"])] = e.get("justification", "")
+        out[(e["checker"], e["path"], e["symbol"])] = {
+            "justification": e.get("justification", ""),
+            "version": int(e.get("version", 1)),
+        }
     return out
+
+
+def checker_versions() -> dict:
+    from oryx_tpu.tools.analyze.checkers import CHECKER_VERSIONS
+
+    return CHECKER_VERSIONS
 
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
     """Skeleton baseline from current unsuppressed findings; justifications
     start as TODO and the suppression-hygiene check fails until they are
-    written by a human."""
+    written by a human. Each entry records the CURRENT checker version so
+    a later precision upgrade invalidates the justification loudly instead
+    of silently re-accepting it against semantics nobody reviewed."""
+    versions = checker_versions()
     entries = [
         {
             "checker": f.checker,
             "path": f.path,
             "symbol": f.symbol or f.message,
             "justification": "TODO: justify this accepted finding",
+            "version": versions.get(f.checker, 1),
         }
         for f in findings
         # hygiene meta-findings are generated after baseline matching and
@@ -710,7 +724,13 @@ def build_project(
     return ProjectContext(files, reference_conf_text), errors
 
 
-def _apply_suppressions(project: ProjectContext, findings: list, baseline: dict) -> list:
+def _apply_suppressions(
+    project: ProjectContext,
+    findings: list,
+    baseline: dict,
+    versions: "dict | None" = None,
+) -> list:
+    versions = versions if versions is not None else checker_versions()
     hygiene: list[Finding] = []
     for f in findings:
         fctx = project.by_relpath.get(f.path)
@@ -735,8 +755,30 @@ def _apply_suppressions(project: ProjectContext, findings: list, baseline: dict)
                     )
                 )
             continue
-        just = baseline.get(f.baseline_key)
-        if just is not None:
+        entry = baseline.get(f.baseline_key)
+        if entry is not None:
+            current = versions.get(f.checker, 1)
+            if entry["version"] != current:
+                # a checker precision upgrade means the accepted finding may
+                # not be the same finding any more: the justification goes
+                # STALE loudly — the original finding stays unsuppressed and
+                # the entry must be re-adjudicated (re-justify + bump, or
+                # delete if the upgrade fixed the false positive)
+                hygiene.append(
+                    Finding(
+                        "suppression-hygiene",
+                        f.path,
+                        f.line,
+                        f"baseline entry for [{f.checker}] "
+                        f"{f.symbol or f.message!r} was justified against "
+                        f"checker v{entry['version']} but the checker is "
+                        f"now v{current} — re-adjudicate the finding and "
+                        "update the entry's version",
+                        symbol=f"{f.checker}:{f.symbol or f.message}:version",
+                    )
+                )
+                continue
+            just = entry["justification"]
             f.suppressed_by = "baseline"
             f.justification = just
             if not just or just.startswith("TODO"):

@@ -1,0 +1,890 @@
+"""Sharding- and dtype-aware dataflow facts for the JAX layer.
+
+The analyze framework's earlier passes see Python control flow (call graph,
+locks, async reachability) but are blind to what a TPU framework actually
+ships: JAX dataflow — array shapes, dtypes, PartitionSpecs, and host↔device
+transfers. This module is the shared abstract-interpretation substrate three
+checkers and the ``analyze --cost`` report ride:
+
+  * **abstract shapes** — tuples of dims, each a concrete int or a *shape
+    symbol* (a short source expression: ``"block + 1"``, ``"k"``, or a
+    parameter-derived ``"y.d0"``), seeded from ``zeros``-style constructors,
+    ``reshape``, ``.T``, and function signatures;
+  * **dtype lattice** — ``int8 ≤ bfloat16 ≤ float32 ≤ float64`` with byte
+    widths, seeded from dtype kwargs, ``.astype`` and dtype constants;
+  * **sharding/placement** — which values are device-resident (produced by
+    ``jnp.*`` / ``jax.device_put`` / a jit program), which are host numpy,
+    and which ``shard_map``/``pjit`` inputs enter a mesh region replicated
+    (``P()`` / all-``None`` PartitionSpec) vs row-sharded;
+  * **cost polynomials** — FLOPs / HBM bytes / collective bytes as symbolic
+    polynomials over shape symbols (:class:`Poly`), evaluable once bound to
+    concrete model shapes (``analyze --cost --bind y.d0=1000000``).
+
+Everything here is stdlib-only and rides the memoized per-file scope caches
+(:func:`core.scope_nodes`) and the shared project call graph — the dataflow
+pass must never rebuild what the concurrency pass already paid for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from oryx_tpu.tools.analyze.core import (
+    module_map,
+    module_name,
+    scope_nodes,
+)
+
+# -- dtype lattice ----------------------------------------------------------
+
+#: Promotion order of the framework's device dtypes. int32/int64 index
+#: arrays deliberately sit outside the lattice: they never carry factor
+#: numerics, and flagging index widening would be pure noise.
+DTYPE_RANK = {"int8": 0, "bfloat16": 1, "float32": 2, "float64": 3}
+DTYPE_BYTES = {"int8": 1, "bfloat16": 2, "float32": 4, "float64": 8}
+#: The deliberately-narrow storage dtypes whose silent widening defeats
+#: their purpose (they exist to halve/quarter HBM traffic).
+LOW_DTYPES = frozenset({"int8", "bfloat16"})
+
+_DTYPE_ORIGINS = {
+    "numpy.int8": "int8", "jax.numpy.int8": "int8",
+    "jax.numpy.bfloat16": "bfloat16", "ml_dtypes.bfloat16": "bfloat16",
+    "numpy.float32": "float32", "jax.numpy.float32": "float32",
+    "numpy.float64": "float64", "jax.numpy.float64": "float64",
+}
+
+
+def dtype_of_node(fctx, node) -> "str | None":
+    """Lattice dtype named by an AST expression (``jnp.bfloat16``,
+    ``"int8"``), or None when it is not a recognized literal dtype."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in DTYPE_RANK else None
+    resolved = fctx.resolve(node)
+    return _DTYPE_ORIGINS.get(resolved or "")
+
+
+# -- cost polynomials -------------------------------------------------------
+
+
+class Poly:
+    """A polynomial over shape symbols: ``{(sym, ...): coeff}`` with ints
+    folded into coefficients. Just enough algebra for static cost models —
+    add, multiply, render (``2·N·k²``), and evaluate under bindings."""
+
+    __slots__ = ("terms",)
+
+    def __init__(self, terms: "dict | None" = None):
+        self.terms = {k: v for k, v in (terms or {}).items() if v}
+
+    @classmethod
+    def const(cls, value: float) -> "Poly":
+        return cls({(): float(value)} if value else {})
+
+    @classmethod
+    def sym(cls, name: str) -> "Poly":
+        return cls({(name,): 1.0})
+
+    @classmethod
+    def of_dim(cls, dim) -> "Poly":
+        return cls.const(dim) if isinstance(dim, (int, float)) else cls.sym(str(dim))
+
+    @classmethod
+    def of_shape(cls, shape) -> "Poly":
+        out = cls.const(1.0)
+        for d in shape:
+            out = out * cls.of_dim(d)
+        return out
+
+    def __add__(self, other: "Poly") -> "Poly":
+        terms = dict(self.terms)
+        for k, v in other.terms.items():
+            terms[k] = terms.get(k, 0.0) + v
+        return Poly(terms)
+
+    def __mul__(self, other) -> "Poly":
+        if isinstance(other, (int, float)):
+            return Poly({k: v * other for k, v in self.terms.items()})
+        terms: dict = {}
+        for ka, va in self.terms.items():
+            for kb, vb in other.terms.items():
+                key = tuple(sorted(ka + kb))
+                terms[key] = terms.get(key, 0.0) + va * vb
+        return Poly(terms)
+
+    def __bool__(self) -> bool:
+        return bool(self.terms)
+
+    def symbols(self) -> set:
+        return {s for key in self.terms for s in key}
+
+    def evaluate(self, bindings: dict) -> "float | None":
+        """Numeric value under ``bindings``; None if any symbol is unbound."""
+        total = 0.0
+        for key, coeff in self.terms.items():
+            val = coeff
+            for s in key:
+                if s not in bindings:
+                    return None
+                val *= float(bindings[s])
+            total += val
+        return total
+
+    def render(self) -> str:
+        if not self.terms:
+            return "0"
+        parts = []
+        for key, coeff in sorted(self.terms.items(), key=lambda kv: (-len(kv[0]), kv[0])):
+            syms: list = []
+            seen: dict = {}
+            for s in key:
+                seen[s] = seen.get(s, 0) + 1
+            for s, p in sorted(seen.items()):
+                syms.append(s if p == 1 else f"{s}^{p}")
+            body = "·".join(syms)
+            if coeff == 1.0 and body:
+                parts.append(body)
+            elif body:
+                c = int(coeff) if float(coeff).is_integer() else coeff
+                parts.append(f"{c}·{body}")
+            else:
+                c = int(coeff) if float(coeff).is_integer() else coeff
+                parts.append(str(c))
+        return " + ".join(parts)
+
+
+# -- abstract shapes --------------------------------------------------------
+
+_MAX_DIM_EXPR = 24
+
+
+def dim_of_node(node) -> "int | str | None":
+    """A dim from an AST expression: int constant, name, or a short source
+    expression kept verbatim as a shape symbol (``"block + 1"``)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, int):
+            return node.value if node.value >= 0 else "?"
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return "?"  # -1 in a reshape: inferred dim
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover — malformed tree
+        return None
+    return text if len(text) <= _MAX_DIM_EXPR else "?"
+
+
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _ctor_shape(call: ast.Call) -> "tuple | None":
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, (ast.Tuple, ast.List)):
+        dims = tuple(dim_of_node(e) for e in arg.elts)
+        return None if any(d is None for d in dims) else dims
+    d = dim_of_node(arg)
+    return None if d is None else (d,)
+
+
+def shape_env(fctx, fn_node) -> dict:
+    """name -> abstract shape for one function scope: a single ordered pass
+    over constructor calls, ``reshape``, ``.T`` and plain aliasing. Meant
+    for the cost model, not soundness — unknown stays unknown."""
+    env: dict = {}
+
+    def shape_of(node) -> "tuple | None":
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute) and node.attr == "T":
+            inner = shape_of(node.value)
+            return tuple(reversed(inner)) if inner else None
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "reshape":
+            if len(node.args) == 1 and isinstance(node.args[0], (ast.Tuple, ast.List)):
+                dims = tuple(dim_of_node(e) for e in node.args[0].elts)
+            else:
+                dims = tuple(dim_of_node(a) for a in node.args)
+            return None if any(d is None for d in dims) else dims
+        if isinstance(func, ast.Attribute) and func.attr == "astype":
+            return shape_of(func.value)
+        resolved = fctx.resolve(func)
+        if resolved:
+            mod, _, name = resolved.rpartition(".")
+            if mod in ("numpy", "jax.numpy") and name in _SHAPE_CTORS:
+                return _ctor_shape(node)
+            if mod in ("numpy", "jax.numpy") and name == "arange" and node.args:
+                d = dim_of_node(node.args[0])
+                return None if d is None else (d,)
+        return None
+
+    for node in scope_nodes(fctx, fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            s = shape_of(node.value)
+            if s is not None:
+                env[node.targets[0].id] = s
+    env["__shape_of__"] = shape_of
+    return env
+
+
+def param_shape(param: str, rank: int = 2) -> tuple:
+    """The signature-derived symbolic shape of a parameter: ``y`` ->
+    ``("y.d0", "y.d1")``. These are the symbols ``--bind`` binds."""
+    return tuple(f"{param}.d{i}" for i in range(rank))
+
+
+# -- device / host placement -----------------------------------------------
+
+#: Calls producing device-resident arrays.
+_DEVICE_PRODUCER_PREFIXES = ("jax.numpy.", "jax.random.", "jax.lax.")
+_DEVICE_PRODUCER_EXACT = {"jax.device_put"}
+
+#: Scalar-extraction transfers: each call is ONE blocking device→host sync.
+SCALAR_TRANSFERS = {"float", "int", "bool"}
+SCALAR_TRANSFER_METHODS = {"item", "tolist"}
+
+def is_device_producer(fctx, call: ast.Call) -> bool:
+    resolved = fctx.resolve(call.func)
+    if not resolved:
+        return False
+    if resolved in _DEVICE_PRODUCER_EXACT:
+        return True
+    return resolved.startswith(_DEVICE_PRODUCER_PREFIXES)
+
+
+def device_returning(project) -> set:
+    """Keys ``(relpath, qualname)`` of project functions whose calls yield
+    device arrays: every jit scope, plus functions whose return expression
+    is locally device-typed (``return jnp.dot(x, y) / n``). Memoized on the
+    project — the host-transfer checker and the cost model both need it."""
+    cached = getattr(project, "_device_returning", None)
+    if cached is not None:
+        return cached
+    out: set = set()
+    for fctx in project.files:
+        jit_nodes = set(fctx.jit_scopes)
+        for qual, fn in fctx.functions:
+            key = (fctx.relpath, qual)
+            if fn in jit_nodes:
+                out.add(key)
+                continue
+            for node in scope_nodes(fctx, fn):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                if _expr_is_device(fctx, node.value, set()):
+                    out.add(key)
+                    break
+    project._device_returning = out
+    return out
+
+
+def _expr_is_device(fctx, node, device_names: set) -> bool:
+    """Conservative device-ness of an expression: a device producer call, a
+    known device name, or arithmetic over either."""
+    if isinstance(node, ast.Name):
+        return node.id in device_names
+    if isinstance(node, ast.Call):
+        if is_device_producer(fctx, node):
+            return True
+        # x.astype(...) / x.sum() style: method on a device value
+        if isinstance(node.func, ast.Attribute):
+            return _expr_is_device(fctx, node.func.value, device_names)
+        return False
+    if isinstance(node, ast.BinOp):
+        return (_expr_is_device(fctx, node.left, device_names)
+                or _expr_is_device(fctx, node.right, device_names))
+    if isinstance(node, ast.Subscript):
+        return _expr_is_device(fctx, node.value, device_names)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_expr_is_device(fctx, e, device_names) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return (_expr_is_device(fctx, node.body, device_names)
+                or _expr_is_device(fctx, node.orelse, device_names))
+    return False
+
+
+def transfer_of_call(fctx, call: ast.Call) -> "str | None":
+    """The host-transfer kind a call performs on its device operand, or
+    None. ``jax.device_get`` is deliberately NOT here: it is the explicit,
+    batched transfer idiom this checker pushes silent syncs toward."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in SCALAR_TRANSFERS:
+        if func.id not in fctx.import_map:
+            return f"{func.id}()"
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in SCALAR_TRANSFER_METHODS and not call.args:
+            return f".{func.attr}()"
+    resolved = fctx.resolve(func)
+    if resolved:
+        mod, _, name = resolved.rpartition(".")
+        # any top-level numpy entry point fetches a device operand: the
+        # conversions (np.asarray, np.array, np.stack, ...) and implicit
+        # op mixing (np.dot, np.where, ...) alike
+        if mod == "numpy" and name:
+            return f"np.{name}()"
+    return None
+
+
+class LineStateEnv:
+    """name -> ``[(line, state)]`` events in ascending line order, answering
+    "what was this name's state just BEFORE line L" — the shared
+    flow-sensitive discipline of :class:`DeviceFlow` and the dtype-widening
+    checker's dtype environment (one implementation so a fix to the
+    lookup/ordering semantics cannot diverge between the two passes)."""
+
+    def __init__(self):
+        self._events: dict = {}
+
+    def record(self, name: str, line: int, state) -> None:
+        self._events.setdefault(name, []).append((line, state))
+
+    def state_before(self, name: str, line: int, default=None):
+        """State of ``name`` just before ``line`` (a same-line assignment
+        has not landed yet)."""
+        state = default
+        for ln, s in self._events.get(name, ()):
+            if ln >= line:
+                break
+            state = s
+        return state
+
+    def final_states(self) -> dict:
+        return {n: evs[-1][1] for n, evs in self._events.items() if evs}
+
+
+class DeviceFlow:
+    """Linear (source-ordered, flow-sensitive) device-placement pass over
+    one function body: which local names hold device arrays BEFORE each
+    line. A name reassigned from a host transfer (``vals =
+    np.asarray(vals)``) leaves the device state from that line on — the
+    widening-retry loops in serving do exactly that, and a flow-insensitive
+    set would false-flag every later use — while the transfer call itself
+    still sees the pre-assignment device value (``gain = np.asarray(gain)``
+    IS a device fetch)."""
+
+    def __init__(self, fctx, fn_node, project):
+        self.fctx = fctx
+        self._dev_ret = device_returning(project)
+        self._mod_of = module_map(project)
+        self._env = LineStateEnv()
+        stmts = sorted(
+            (n for n in scope_nodes(fctx, fn_node)
+             if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.For, ast.AsyncFor))),
+            key=lambda n: n.lineno,
+        )
+        for stmt in stmts:
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                # the loop target binds one ELEMENT of the iterable per
+                # step: iterating a device array yields device scalars
+                # (`for s in scores:` — the per-element sync shape), and a
+                # host iterable rebinds/shadows any earlier device name
+                dev = self.expr_is_device(stmt.iter, stmt.lineno)
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        self._env.record(n.id, stmt.lineno, dev)
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            dev = self._value_is_device(value, stmt.lineno)
+            if isinstance(stmt, ast.AugAssign):
+                # `acc += 1` combines the RHS with acc's PRIOR state: a
+                # host-scalar increment must not downgrade a device name
+                # and hide every later sync on it
+                dev = dev or self.expr_is_device(stmt.target, stmt.lineno)
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        self._env.record(n.id, stmt.lineno, dev)
+
+    def name_is_device(self, name: str, line: int) -> bool:
+        return bool(self._env.state_before(name, line, False))
+
+    @property
+    def device(self) -> set:
+        """Final-state device names (closure-capture checks)."""
+        return {n for n, dev in self._env.final_states().items() if dev}
+
+    def _value_is_device(self, node, line: int) -> bool:
+        if isinstance(node, ast.Call) and transfer_of_call(self.fctx, node):
+            return False  # a transfer call yields HOST data
+        return self.expr_is_device(node, line)
+
+    def call_returns_device(self, call: ast.Call) -> bool:
+        """Device-ness of a call result: jnp producers, or a resolvable
+        project function in the ``device_returning`` set."""
+        if is_device_producer(self.fctx, call):
+            return True
+        resolved = self.fctx.resolve(call.func)
+        if resolved and "." in resolved:
+            mod, _, name = resolved.rpartition(".")
+            target = self._mod_of.get(mod)
+            if target is not None and (target.relpath, name) in self._dev_ret:
+                return True
+        if isinstance(call.func, ast.Name):
+            local = self.fctx.functions_by_name.get(call.func.id)
+            if local:
+                qual = self.fctx.qualname_of.get(local[0])
+                if qual and (self.fctx.relpath, qual) in self._dev_ret:
+                    return True
+        return False
+
+    def expr_is_device(self, node, line: int) -> bool:
+        """Device-ness of an expression evaluated at ``line``."""
+        if isinstance(node, ast.Name):
+            return self.name_is_device(node.id, line)
+        if isinstance(node, ast.Call):
+            if self.call_returns_device(node):
+                return True
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in SCALAR_TRANSFER_METHODS:
+                    return False  # .item()/.tolist() results are host
+                return self.expr_is_device(node.func.value, line)
+            return False
+        if isinstance(node, ast.BinOp):
+            return (self.expr_is_device(node.left, line)
+                    or self.expr_is_device(node.right, line))
+        if isinstance(node, ast.Subscript):
+            return self.expr_is_device(node.value, line)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "dtype", "ndim", "size", "nbytes"):
+                return False  # metadata reads never transfer
+            return self.expr_is_device(node.value, line)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_is_device(e, line) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self.expr_is_device(node.body, line)
+                    or self.expr_is_device(node.orelse, line))
+        return False
+
+
+def async_reachable(project) -> set:
+    """Keys of every function reachable FROM an ``async def`` over the call
+    graph — the functions whose synchronous work runs on the event loop.
+    Callables handed to ``to_thread``/``run_in_executor`` are references,
+    not calls, so the sanctioned executor hop naturally stays outside this
+    set. Memoized on the project."""
+    cached = getattr(project, "_async_reachable", None)
+    if cached is not None:
+        return cached
+    graph = project.call_graph()
+    seen = set(graph.async_keys)
+    stack = list(seen)
+    while stack:
+        key = stack.pop()
+        for _, callee, _ in graph.edges.get(key, ()):
+            if callee not in seen:
+                seen.add(callee)
+                stack.append(callee)
+    project._async_reachable = seen
+    return seen
+
+
+# -- shard_map / pjit region parsing ---------------------------------------
+
+_SHARD_MAP_ORIGINS = {
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.pjit", "jax.experimental.pjit.pjit",
+}
+_PSPEC_ORIGINS = {
+    "jax.sharding.PartitionSpec", "jax.experimental.pjit.PartitionSpec",
+}
+
+
+def _is_pspec(fctx, node) -> "bool | None":
+    """True = replicated spec (``P()`` / all-None), False = sharded spec,
+    None = not a PartitionSpec expression."""
+    if not isinstance(node, ast.Call):
+        return None
+    if fctx.resolve(node.func) not in _PSPEC_ORIGINS:
+        return None
+    axes = [a for a in node.args
+            if not (isinstance(a, ast.Constant) and a.value is None)]
+    return len(axes) == 0
+
+
+def _resolve_specs_kwargs(fctx, fn_node, call: ast.Call) -> dict:
+    """The effective kwargs of a shard_map call, following one level of
+    ``**specs`` indirection into a local ``specs = dict(...)`` assignment —
+    the idiom ``train._sharded_solver`` uses."""
+    out: dict = {}
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+            continue
+        if not isinstance(kw.value, ast.Name) or fn_node is None:
+            continue
+        for node in scope_nodes(fctx, fn_node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Name) and t.id == kw.value.id):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                    and v.func.id == "dict":
+                for inner in v.keywords:
+                    if inner.arg is not None:
+                        out.setdefault(inner.arg, inner.value)
+            elif isinstance(v, ast.Dict):
+                for k_node, v_node in zip(v.keys, v.values):
+                    if isinstance(k_node, ast.Constant):
+                        out.setdefault(str(k_node.value), v_node)
+    return out
+
+
+class ShardRegion:
+    """One parsed ``shard_map``/``pjit`` call site: the wrapped function and
+    the per-parameter replication decisions."""
+
+    __slots__ = ("fctx", "call", "wrapped_qual", "wrapped_node",
+                 "replicated", "sharded", "enclosing")
+
+    def __init__(self, fctx, call, wrapped_qual, wrapped_node, replicated,
+                 sharded, enclosing):
+        self.fctx = fctx
+        self.call = call
+        self.wrapped_qual = wrapped_qual
+        self.wrapped_node = wrapped_node
+        self.replicated = replicated  # [param name, ...]
+        self.sharded = sharded
+        self.enclosing = enclosing  # function node containing the call
+
+
+def shard_regions(project) -> list:
+    """Every statically-parsable shard_map/pjit region in the project,
+    memoized. A region needs a name-referenced wrapped function and a
+    literal (or one-hop ``**specs``) ``in_specs`` tuple of PartitionSpec
+    calls — anything else is skipped, never guessed."""
+    cached = getattr(project, "_shard_regions", None)
+    if cached is not None:
+        return cached
+    out: list = []
+    for fctx in project.files:
+        # textual pre-gate: parsing specs only matters in the handful of
+        # files that mention the transforms at all (keeps the pass off the
+        # analyzer's 3 s budget)
+        if "shard_map" not in fctx.source and "pjit" not in fctx.source:
+            continue
+        containing: dict = {}
+        for qual, fn in fctx.functions:
+            for node in scope_nodes(fctx, fn):
+                containing[id(node)] = fn
+        for node in ast.walk(fctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and fctx.resolve(node.func) in _SHARD_MAP_ORIGINS):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Name)):
+                continue
+            fns = fctx.functions_by_name.get(node.args[0].id)
+            if not fns:
+                continue
+            wrapped = fns[0]
+            enclosing = containing.get(id(node))
+            kwargs = _resolve_specs_kwargs(fctx, enclosing, node)
+            in_specs = kwargs.get("in_specs")
+            if not isinstance(in_specs, (ast.Tuple, ast.List)):
+                continue
+            args_node = wrapped.args
+            params = [a.arg for a in args_node.posonlyargs + args_node.args]
+            replicated, sharded = [], []
+            for i, spec in enumerate(in_specs.elts):
+                if i >= len(params):
+                    break
+                rep = _is_pspec(fctx, spec)
+                if rep is True:
+                    replicated.append(params[i])
+                elif rep is False:
+                    sharded.append(params[i])
+            out.append(ShardRegion(
+                fctx, node, fctx.qualname_of.get(wrapped, wrapped.name),
+                wrapped, replicated, sharded, enclosing,
+            ))
+    project._shard_regions = out
+    return out
+
+
+# -- model-scaled evidence --------------------------------------------------
+
+
+def _alias_roots(node) -> set:
+    """Names an expression is a pure alias/cast of: ``y``, ``y.astype(cd)``,
+    ``y if p else y.astype(cd)``. A call with other argument roots is NOT an
+    alias — derived-ness must not flow through arbitrary call results."""
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Attribute):
+        return _alias_roots(node.value)
+    if isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("astype", "copy"):
+            return _alias_roots(func.value)
+        return set()
+    if isinstance(node, ast.IfExp):
+        return _alias_roots(node.body) | _alias_roots(node.orelse)
+    return set()
+
+
+def _param_aliases(fctx, fn_node, param: str) -> set:
+    names = {param}
+    for _ in range(2):
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                roots = _alias_roots(node.value)
+                if roots and roots <= names:
+                    names.add(node.targets[0].id)
+    return names
+
+
+def _static_index(node) -> bool:
+    """Indices that slice structure rather than gather by data: constants,
+    slices of constants/names, None-extensions."""
+    if isinstance(node, (ast.Constant, ast.Slice)):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _static_index(node.operand)
+    if isinstance(node, ast.Tuple):
+        return all(_static_index(e) for e in node.elts)
+    return False
+
+
+def _direct_gather_evidence(fctx, fn_node, param: str) -> bool:
+    """Does ``param`` look like a factor TABLE inside ``fn_node``? Evidence:
+    a data-indexed subscript (``y[cs]``), ``jnp.take(y, …)``, or the
+    self-Gramian ``y.T @ y``. Batch-shaped operands (queries, masks) are
+    matmul'd or masked but never gathered by data — that asymmetry is what
+    separates the replicated-factor hazard from deliberate small
+    broadcasts. Walks nested defs: scan bodies close over the table."""
+    aliases = _param_aliases(fctx, fn_node, param)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Subscript):
+            roots = _alias_roots(node.value)
+            if roots and roots <= aliases and not _static_index(node.slice):
+                return True
+        elif isinstance(node, ast.Call):
+            resolved = fctx.resolve(node.func)
+            if resolved in ("jax.numpy.take", "numpy.take") and node.args:
+                roots = _alias_roots(node.args[0])
+                if roots and roots <= aliases:
+                    return True
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            left, right = _alias_roots(node.left), _alias_roots(node.right)
+            if left and right and left <= aliases and right <= aliases:
+                return True  # y.T @ y: the Gramian of a factor table
+    return False
+
+
+def model_scaled_params(project, fctx, fn_node) -> set:
+    """Parameters of ``fn_node`` whose abstract size scales with a model
+    dimension: direct gather/Gramian evidence, or the same evidence one
+    positional-argument hop away in a project callee."""
+    args = fn_node.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    out = {p for p in params if _direct_gather_evidence(fctx, fn_node, p)}
+    mod_of = module_map(project)
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = None
+        if isinstance(node.func, ast.Name):
+            local = fctx.functions_by_name.get(node.func.id)
+            if local:
+                callee = (fctx, local[0])
+        else:
+            resolved = fctx.resolve(node.func)
+            if resolved and "." in resolved:
+                mod, _, name = resolved.rpartition(".")
+                target = mod_of.get(mod)
+                if target is not None and name in target.functions_by_name:
+                    callee = (target, target.functions_by_name[name][0])
+        if callee is None:
+            continue
+        cfctx, cnode = callee
+        cargs = cnode.args
+        cparams = [a.arg for a in cargs.posonlyargs + cargs.args]
+        for i, arg in enumerate(node.args):
+            if i >= len(cparams):
+                break
+            roots = _alias_roots(arg)
+            if not roots:
+                continue
+            for p in params:
+                if p in out:
+                    continue
+                if roots <= _param_aliases(fctx, fn_node, p) and \
+                        _direct_gather_evidence(cfctx, cnode, cparams[i]):
+                    out.add(p)
+    return out
+
+
+def replicated_capture_names(project, region: ShardRegion) -> list:
+    """Free names of the wrapped function bound to device arrays in the
+    enclosing scope: a closure-captured factor table enters the region
+    replicated exactly like a ``P()`` in_spec, with no spec line to review."""
+    if region.enclosing is None:
+        return []
+    flow = DeviceFlow(region.fctx, region.enclosing, project)
+    args = region.wrapped_node.args
+    bound = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    local_assigns = {
+        n.id
+        for s in ast.walk(region.wrapped_node)
+        if isinstance(s, ast.Assign)
+        for t in s.targets
+        for n in ast.walk(t)
+        if isinstance(n, ast.Name)
+    }
+    out = []
+    for node in ast.walk(region.wrapped_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            name = node.id
+            if name in bound or name in local_assigns or name in out:
+                continue
+            if name in flow.device:
+                out.append(name)
+    return out
+
+
+# -- per-program cost model -------------------------------------------------
+
+_CONTRACTIONS = {"jax.numpy.matmul", "jax.numpy.dot", "jax.numpy.tensordot"}
+
+
+def _einsum_cost(fctx, call: ast.Call, senv: dict) -> "tuple[Poly, Poly] | None":
+    """(flops, bytes) of one einsum: FLOPs = 2·Π(distinct index extents),
+    bytes = operand + output sizes at 4 B. Extents come from operand shapes
+    when the shape env knows them, else stay symbolic by index letter."""
+    if not (call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return None
+    spec = call.args[0].value.replace(" ", "")
+    if "->" not in spec:
+        return None
+    lhs, rhs = spec.split("->", 1)
+    in_specs = lhs.split(",")
+    operands = call.args[1:1 + len(in_specs)]
+    shape_of = senv.get("__shape_of__")
+    letter_dim: dict = {}
+    for op_spec, op_node in zip(in_specs, operands):
+        shape = shape_of(op_node) if shape_of else None
+        for i, letter in enumerate(op_spec):
+            if letter in letter_dim:
+                continue
+            if shape is not None and i < len(shape):
+                letter_dim[letter] = shape[i]
+            else:
+                letter_dim[letter] = letter
+    flops = Poly.const(2.0)
+    for letter in sorted(set(lhs.replace(",", "")) | set(rhs)):
+        flops = flops * Poly.of_dim(letter_dim.get(letter, letter))
+    bytes_ = Poly.const(0.0)
+    for op_spec in in_specs + [rhs]:
+        term = Poly.const(4.0)
+        for letter in op_spec:
+            term = term * Poly.of_dim(letter_dim.get(letter, letter))
+        bytes_ = bytes_ + term
+    return flops, bytes_
+
+
+def _operand_shape(fctx, node, senv, transpose_ok=True) -> tuple:
+    shape_of = senv.get("__shape_of__")
+    s = shape_of(node) if shape_of else None
+    if s is not None:
+        return s
+    # signature-derived fallback: a bare parameter name gets p.d0 × p.d1
+    if isinstance(node, ast.Name):
+        return param_shape(node.id)
+    if isinstance(node, ast.Attribute) and node.attr == "T" and transpose_ok:
+        return tuple(reversed(_operand_shape(fctx, node.value, senv, False)))
+    return ("?", "?")
+
+
+def _matmul_cost(fctx, left, right, senv) -> "tuple[Poly, Poly]":
+    a = _operand_shape(fctx, left, senv)
+    b = _operand_shape(fctx, right, senv)
+    dims = list(a[:-1]) + [b[-1] if len(b) else "?"]
+    if len(a) >= 2:
+        dims.append(a[-1])  # the contracted extent
+    flops = Poly.const(2.0) * Poly.of_shape(dims)
+    bytes_ = (Poly.of_shape(a) + Poly.of_shape(b)) * 4.0
+    return flops, bytes_
+
+
+def program_cost(project, fctx, scope) -> dict:
+    """Static cost of one jit program: FLOPs/HBM-bytes polynomials from its
+    contractions and gathers (elementwise traffic is second-order and
+    skipped), plus collective bytes from any shard_map region whose wrapped
+    function is this scope. Loop/scan bodies count ONCE — the table is a
+    per-dispatch (or per-chunk) roofline to diff in review, not a cycle
+    counter."""
+    senv = shape_env(fctx, scope.node)
+    flops = Poly.const(0.0)
+    hbm = Poly.const(0.0)
+    for node in ast.walk(scope.node):
+        if isinstance(node, ast.Call):
+            resolved = fctx.resolve(node.func)
+            if resolved in ("jax.numpy.einsum", "numpy.einsum"):
+                cost = _einsum_cost(fctx, node, senv)
+                if cost:
+                    flops, hbm = flops + cost[0], hbm + cost[1]
+            elif resolved in _CONTRACTIONS and len(node.args) >= 2:
+                f, b = _matmul_cost(fctx, node.args[0], node.args[1], senv)
+                flops, hbm = flops + f, hbm + b
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            f, b = _matmul_cost(fctx, node.left, node.right, senv)
+            flops, hbm = flops + f, hbm + b
+        elif isinstance(node, ast.Subscript) and not _static_index(node.slice):
+            shape_of = senv.get("__shape_of__")
+            s = shape_of(node.value) if shape_of else None
+            if s is not None:
+                hbm = hbm + Poly.of_shape(s) * 4.0  # data-indexed gather
+    collective = Poly.const(0.0)
+    gathered: set = set()
+    for region in shard_regions(project):
+        if region.fctx is not fctx or region.wrapped_node is not scope.node:
+            continue
+        # several call SITES may wrap one function (the try/except jax-API
+        # fallback idiom builds the same region twice; only one executes):
+        # each replicated table is priced once per program, not per site
+        scaled = model_scaled_params(project, fctx, region.wrapped_node)
+        for p in region.replicated:
+            if p in scaled and p not in gathered:
+                gathered.add(p)
+                collective = collective + replicated_bytes(p)
+    return {"flops": flops, "hbm_bytes": hbm, "collective_bytes": collective}
+
+
+def replicated_bytes(param: str, dtype: str = "float32") -> Poly:
+    """Per-call all-gather bytes of one replicated table: Π(signature dims)
+    × itemsize — ``y`` -> ``y.d0·y.d1·4``."""
+    return Poly.of_shape(param_shape(param)) * float(DTYPE_BYTES[dtype])
+
+
+def cost_report(project) -> list:
+    """One row per jit program, sorted by path/line — the ``analyze --cost``
+    payload. Rows carry Poly objects; the CLI renders/evaluates them."""
+    rows = []
+    for fctx in project.files:
+        for scope in fctx.jit_scopes.values():
+            cost = program_cost(project, fctx, scope)
+            if not (cost["flops"] or cost["hbm_bytes"]
+                    or cost["collective_bytes"]):
+                continue
+            rows.append({
+                "program": f"{module_name(fctx.relpath)}.{scope.qualname}",
+                "path": fctx.relpath,
+                "line": scope.node.lineno,
+                **cost,
+            })
+    rows.sort(key=lambda r: (r["path"], r["line"]))
+    return rows

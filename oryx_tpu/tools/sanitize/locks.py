@@ -93,6 +93,22 @@ def _site_of_allocation() -> "str | None":
             # repo frame beyond the import machinery merely triggered the
             # import and must not claim the lock
             return helper_site
+        if (
+            fname.endswith("threading.py")
+            and f.f_code.co_name == "__init__"
+            and isinstance(f.f_locals.get("self"), threading.Thread)
+        ):
+            # thread-STARTUP machinery: the `_started` Event's condition
+            # lock allocated inside Thread.__init__. It is per-instance,
+            # never user-shared, and held only across Thread.start() — but
+            # the repo frame that created the thread (a to_thread dispatch
+            # spawning a lazy executor worker) would claim it, and SITE
+            # aggregation across instances then fabricates order edges
+            # between unrelated thread spawns (a phantom cycle the suite
+            # gate trips on). Leave it a real lock. A repo Thread
+            # SUBCLASS's own locks allocate in the subclass's __init__
+            # frame, not threading.py's, and stay instrumented.
+            return None
         if "/tools/sanitize/" not in fname and any(
             m in fname for m in _REPO_MARKERS
         ):

@@ -209,6 +209,174 @@ def test_chaos_update_consumer_crash_restarts_within_budget(chaos_pair):
     assert _bundle_events(client, "consumer.restart")
 
 
+def test_chaos_consumer_survives_rebuild_with_broker_down(tmp_path_factory):
+    """Regression for the fleet SPOF drill's 'never drained' stall: the
+    supervised consumer's RESTART step itself performs broker RPCs (the
+    iterator constructor reads partition counts + stored offsets). A broker
+    still down at rebuild time used to raise out of the supervision loop
+    and kill the consumer thread permanently — the replica served forever
+    but never consumed again. The rebuild now runs inside the supervised
+    try: failed rebuilds back off and retry, and consumption resumes once
+    the broker returns."""
+    from oryx_tpu.transport import netbroker
+    from tests.test_serving import _publish_to_topic, _train_tiny
+
+    tp.reset_tcp_clients()
+    faults.disarm()
+    tmp_path = tmp_path_factory.mktemp("rebuild-model")
+    broker_dir = str(tmp_path_factory.mktemp("rebuild-broker"))
+    server = netbroker.NetBrokerServer(
+        broker_dir, host="127.0.0.1", port=0,
+    ).start_background()
+    broker_url = f"tcp://127.0.0.1:{server.port}"
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "rebuild-e2e",
+            "oryx.input-topic.broker": broker_url,
+            "oryx.update-topic.broker": broker_url,
+            "oryx.serving.api.port": port,
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.resilience.retry.base-delay-ms": 2,
+            "oryx.resilience.retry.max-delay-ms": 20,
+            "oryx.resilience.consumer-restart.base-delay-ms": 20,
+            "oryx.resilience.consumer-restart.max-delay-ms": 100,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    pmml, batch, known = _train_tiny(tmp_path)
+    _publish_to_topic(pmml, tmp_path, known, broker_url)
+    serving = ServingLayer(config)
+    serving.start()
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=60)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if client.get("/ready").status_code == 200:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("serving layer never became ready")
+        user = batch.users.index_to_id[0]
+
+        # outage FIRST, then crash the consumer: every rebuild attempt hits
+        # a dead broker until the restart below
+        broker_port = server.port
+        server.close()
+        restarts_before = serving.consumer_restarts
+        faults.arm("serving.update_consume=fail:1", seed=0)
+        try:
+            # the consumer is blocked in a broker read against the dead
+            # server; that read fails on its own (transient OSError ->
+            # crash -> supervised restart), and the armed fault covers the
+            # case where it was between reads instead
+            deadline = time.monotonic() + 20
+            while serving.consumer_restarts < restarts_before + 2:
+                # >= 2 restarts while the broker is DOWN proves the thread
+                # survived at least one failed rebuild (it used to die
+                # during the first)
+                assert time.monotonic() < deadline, (
+                    f"consumer thread died instead of retrying its rebuild "
+                    f"(restarts: {serving.consumer_restarts})"
+                )
+                assert client.get(f"/recommend/{user}").status_code == 200
+                time.sleep(0.05)
+        finally:
+            faults.disarm()
+
+        # broker returns on the SAME port over the same durable dir:
+        # consumption must resume without operator action
+        server = netbroker.NetBrokerServer(
+            broker_dir, host="127.0.0.1", port=broker_port,
+        ).start_background()
+        tp.TopicProducerImpl(broker_url, "OryxUpdate").send(
+            "UP", '["Y", "rebuild-item", [0.1, 0.1, 0.1, 0.1]]'
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            model = serving.manager.get_model()
+            if model is not None and model.get_item_vector(
+                "rebuild-item"
+            ) is not None:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("consumer never resumed after the broker returned")
+        assert client.get(f"/recommend/{user}").status_code == 200
+    finally:
+        faults.disarm()
+        client.close()
+        serving.close()
+        server.close()
+        tp.reset_tcp_clients()
+
+
+def test_chaos_close_during_rebuild_storm_joins_consumer(tmp_path_factory):
+    """close() racing the restart storm: with the broker down and the
+    consumer cycling through failed rebuilds, closing the layer must stop
+    the thread promptly — a rebuild that completes after close() closed the
+    old iterator re-checks _stopped and closes its own fresh iterator
+    instead of blocking in consume() on it forever (review finding)."""
+    from oryx_tpu.transport import netbroker
+    from tests.test_serving import _publish_to_topic, _train_tiny
+
+    tp.reset_tcp_clients()
+    faults.disarm()
+    tmp_path = tmp_path_factory.mktemp("storm-model")
+    server = netbroker.NetBrokerServer(
+        str(tmp_path_factory.mktemp("storm-broker")), host="127.0.0.1", port=0,
+    ).start_background()
+    broker_url = f"tcp://127.0.0.1:{server.port}"
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "storm-e2e",
+            "oryx.input-topic.broker": broker_url,
+            "oryx.update-topic.broker": broker_url,
+            "oryx.serving.api.port": ioutils.choose_free_port(),
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.resilience.retry.base-delay-ms": 2,
+            "oryx.resilience.retry.max-delay-ms": 20,
+            "oryx.resilience.consumer-restart.base-delay-ms": 20,
+            "oryx.resilience.consumer-restart.max-delay-ms": 100,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    pmml, batch, known = _train_tiny(tmp_path)
+    _publish_to_topic(pmml, tmp_path, known, broker_url)
+    serving = ServingLayer(config)
+    serving.start()
+    try:
+        server.close()  # outage: the consumer enters the restart storm
+        faults.arm("serving.update_consume=fail:1", seed=0)
+        try:
+            deadline = time.monotonic() + 20
+            while serving.consumer_restarts < 1:
+                assert time.monotonic() < deadline, "storm never started"
+                time.sleep(0.02)
+        finally:
+            faults.disarm()
+    finally:
+        t0 = time.monotonic()
+        serving.close()
+        thread = serving._consumer_thread
+        if thread is not None:
+            thread.join(timeout=10)
+            assert not thread.is_alive(), (
+                "consumer thread stranded on a just-rebuilt iterator after "
+                f"close() ({time.monotonic() - t0:.1f}s)"
+            )
+        server.close()
+        tp.reset_tcp_clients()
+
+
 def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
     """Device-call failures past the threshold: requests NEVER error (the
     failed batch retries per-request, open-breaker traffic degrades to

@@ -24,6 +24,31 @@ def test_dot_norm_cosine():
     )
 
 
+def test_cosine_similarities_batched_matches_per_pair():
+    """The batched form (one device call + one transfer for the whole list
+    — what the similarity/because endpoints now use instead of a per-pair
+    float() sync loop) must agree with the scalar function pair by pair."""
+    rng = np.random.default_rng(7)
+    rows = rng.standard_normal((17, 8)).astype(np.float32)
+    y = rng.standard_normal(8).astype(np.float32)
+    batched = vm.cosine_similarities(rows, y)
+    assert isinstance(batched, np.ndarray) and batched.dtype == np.float32
+    assert batched.shape == (17,)
+    for i in range(len(rows)):
+        assert batched[i] == pytest.approx(
+            float(vm.cosine_similarity(rows[i], y)), rel=1e-5
+        )
+    # precomputed-norm variant (the handlers pass norm_to)
+    ny = float(np.linalg.norm(y))
+    np.testing.assert_allclose(
+        vm.cosine_similarities(rows, y, norm_y=ny), batched, rtol=1e-6
+    )
+    # accepts a python list of vectors, as the handlers' np.stack feed does
+    np.testing.assert_allclose(
+        vm.cosine_similarities(list(rows), y), batched, rtol=1e-6
+    )
+
+
 def test_transpose_times_self():
     rows = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
     g = np.asarray(vm.transpose_times_self(rows))

@@ -126,6 +126,29 @@ def test_long_hold_outlier_reported_with_duration():
 # ---------------------------------------------------------------------------
 
 
+def test_thread_startup_event_locks_stay_real():
+    """The `_started` Event lock allocated inside ``Thread.__init__`` is
+    per-instance thread-startup machinery: wrapping it would let SITE
+    aggregation fabricate order edges between unrelated thread spawns (the
+    phantom cycle two concurrent lazy-executor spawns produced at two
+    ``to_thread`` dispatch sites). It must stay a real lock even though a
+    repo frame created the thread — while a Thread SUBCLASS's own locks,
+    allocated in the subclass's ``__init__`` frame, stay instrumented."""
+    sanitize.install({"locks"})
+    with sanitize.isolated():
+        t = threading.Thread(target=lambda: None)
+        assert type(t._started._cond._lock).__name__ != "SanRLock"
+
+        class Worker(threading.Thread):
+            def __init__(self):
+                super().__init__(target=lambda: None)
+                self.my_lock = threading.Lock()  # repo-frame alloc: wrapped
+
+        w = Worker()
+        assert type(w.my_lock).__name__ == "SanLock"
+        assert type(w._started._cond._lock).__name__ != "SanRLock"
+
+
 def test_installed_wrappers_catch_deadlock_shaped_threads():
     sanitize.install({"locks"})
     with sanitize.isolated() as (graph, _watch):

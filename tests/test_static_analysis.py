@@ -1393,6 +1393,22 @@ def test_metrics_keys_are_declared_and_read(project_analysis):
     assert conf.get_int("oryx.metrics.max-label-cardinality") > 0
 
 
+def test_every_checker_has_a_registered_version():
+    """The baseline records a version per entry (stale-justification
+    invalidation); every registered checker must therefore expose one —
+    a new checker without a version would write un-invalidatable
+    acceptances."""
+    from oryx_tpu.tools.analyze.checkers import ALL_CHECKERS, CHECKER_VERSIONS
+
+    assert set(CHECKER_VERSIONS) == {c.id for c in ALL_CHECKERS}
+    assert all(isinstance(v, int) and v >= 1
+               for v in CHECKER_VERSIONS.values())
+    # the dataflow family is registered
+    for cid in ("replicated-collective", "host-device-transfer",
+                "dtype-widening"):
+        assert cid in CHECKER_VERSIONS
+
+
 def test_cli_analyze_json_exit_zero(capsys):
     from oryx_tpu.cli.main import main
 
