@@ -155,6 +155,10 @@ def run_batch_bench(
         )
     cells = int(user_side.scols.size + item_side.scols.size)
     record["slot_fill"] = round(2 * nnz / cells, 3)  # issued-FLOP efficiency
+    # static kernel-model VMEM rows at THIS bench's kernel bindings — what
+    # `analyze --cost --bind` would price, embedded so trace_summary --batch
+    # can render the footprint next to the measured throughput
+    record["kernels"] = _kernel_vmem_rows(k, user_side.slot_width)
 
     lam, alpha = 0.001, 1.0
     y = tr.init_item_factors(item_side, n_items, k, jax.random.PRNGKey(0))
@@ -273,6 +277,37 @@ def run_batch_bench(
     # the other two batch-tier phases of the north-star loop (train →
     # speed-update → serve): CSV ingest and speed-layer fold-in
     return record
+
+
+def _kernel_vmem_rows(k: int, slot_width: int) -> list:
+    """Static kernel VMEM/HBM rows (tools/analyze/kernelmodel.py) evaluated
+    at the bench's shapes: features k, the pack's slot width T, and the spd
+    batch tile the runtime gate picks for k. Best-effort — an analysis
+    hiccup must never cost the bench its measured numbers."""
+    try:
+        import oryx_tpu
+        from oryx_tpu.ops.pallas_kernels import spd_tile_b
+        from oryx_tpu.tools.analyze.core import build_project
+        from oryx_tpu.tools.analyze.kernelmodel import kernel_cost_report
+
+        pkg = os.path.dirname(os.path.abspath(oryx_tpu.__file__))
+        project, _ = build_project(
+            [os.path.join(pkg, "ops", "pallas_kernels.py")],
+            root=os.path.dirname(pkg),
+        )
+        bindings = {"k": k, "t": slot_width, "tile_b": spd_tile_b(k)}
+        rows = []
+        for r in kernel_cost_report(project, bindings):
+            rows.append({
+                "kernel": r["kernel"].rsplit(".", 1)[-1],
+                "grid": r["grid"],
+                "vmem_bytes": r["vmem_bytes_value"],
+                "vmem_expr": r["vmem_bytes"].render(),
+                "hbm_bytes_per_step": r["hbm_bytes_per_step_value"],
+            })
+        return rows
+    except Exception as e:  # pragma: no cover — defensive
+        return [{"error": f"{type(e).__name__}: {e}"}]
 
 
 def run_phase_split(user_side, y, lam, alpha, k, device_sync) -> dict:

@@ -344,6 +344,30 @@ oryx = {
     spec = null
   }
 
+  # Static analyzer budgets (tools/analyze/kernelmodel.py): the VMEM math
+  # behind the Pallas kernel checker family and the `analyze --cost` kernel
+  # table. These are the single source of truth the runtime kernel gates
+  # (ops/pallas_kernels._GG_MAX_FEATURES, the spd batch-tile sizing) are
+  # pinned against by tests/test_kernel_differential.py — change a budget
+  # here and the consistency gate recomputes what the kernels may claim.
+  analyze = {
+    kernel = {
+      # Per-core VMEM (TPU v4/v5e ~16 MB): the ceiling a kernel's whole
+      # resident footprint (pipelined blocks x2 + scratch) is checked
+      # against by kernel-vmem-budget.
+      vmem-limit-bytes = 16777216
+      # Scoped-VMEM budget for the LARGEST single buffer of a grid-tiled
+      # kernel ((7 << 17) f32 elements ~ 3.5 MB) — what spd_solve_batched
+      # sizes its batch tile under.
+      scoped-budget-bytes = 3670016
+      # Resident-state budget for accumulator kernels whose output blocks
+      # stay VMEM-resident across grid steps (the gather-Gramian shape);
+      # 1.5 MB ratifies _GG_MAX_FEATURES = 256 exactly
+      # (docs/static_analysis.md "Pallas kernel family").
+      resident-budget-bytes = 1572864
+    }
+  }
+
   # Runtime concurrency sanitizer (tools/sanitize): opt-in via the
   # ORYX_SANITIZE=locks,loop environment variable (it must install before
   # any lock is allocated, so the MODE cannot live in config); these keys

@@ -548,7 +548,7 @@ class BlockedLayoutCache:
 
 def _solve_block(y, srow, scols, svals, slens, *, block, features, lam, alpha,
                  implicit, slot_chunk, yty, compute_dtype=jnp.float32,
-                 spd_kernel=False, fused_gramian=False, kernel_interpret=True):
+                 spd_kernel=False, fused_gramian=False, kernel_interpret):
     """Solve one row block's factors against fixed column factors ``y``.
 
     srow: (S,) block-local int32 in [0, block] (block = spill/padding);
@@ -742,10 +742,15 @@ def solve_side_blocked(y, srows, scols, svals, slens, lam, alpha, *, block,
 @functools.lru_cache(maxsize=64)
 def _sharded_solver(mesh, row_axis, block, features, implicit, slot_chunk,
                     dtype="float32", spd_kernel=False, fused_gramian=False,
-                    kernel_interpret=True):
+                    kernel_interpret=None):
     """jit(shard_map) for one half-iteration: blocks shard over ``row_axis``,
     opposite factors replicated, output factors row-partitioned (pinned by
-    out_specs). Cached per (mesh, statics)."""
+    out_specs). Cached per (mesh, statics). ``kernel_interpret=None``
+    resolves from the MESH's target devices — a caller that forgets the
+    flag must never silently emulate the Pallas kernels on chip (the
+    kernel-interpret-default class; every production caller passes it)."""
+    if kernel_interpret is None:
+        kernel_interpret = not _use_spd_kernel(mesh=mesh)
     from jax.sharding import PartitionSpec as P
 
     try:

@@ -137,6 +137,28 @@ def _spd_solve_call(a, b, *, tile_b: int, interpret: bool):
     )(a, b)
 
 
+# Batch-tile sizing for the SPD solve: the largest single VMEM buffer is
+# the augmented scratch (tile_b, k, k+1) — its k+1 lanes pad to the NEXT
+# 128 multiple (at k=128 that is 256, not 128) — and the scoped-VMEM stack
+# limit is 16 MB, so budget ~3.5 MB for that largest buffer. The budget and
+# cap below are pinned against the static kernel model's padded-byte math
+# (tools/analyze/kernelmodel.py + oryx.analyze.kernel.scoped-budget-bytes)
+# by tests/test_kernel_differential.py: drift in either direction fails
+# tier-1.
+_SPD_SCOPED_BUDGET_BYTES = (7 << 17) * 4
+_SPD_MAX_TILE = 256
+
+
+def spd_tile_b(k: int) -> int:
+    """The batch-tile height the SPD kernel runs at for ``k`` features: the
+    largest multiple of 8 (≤ ``_SPD_MAX_TILE``) whose augmented scratch
+    tile_b × pad8(k) × pad128(k+1) × 4 B fits the scoped-VMEM budget.
+    Below 8 the kernel does not fit and callers fall back to cholesky."""
+    k_padded = _pad_dim(k, 8) * _pad_dim(k + 1, _LANE)
+    return min(_SPD_MAX_TILE,
+               (_SPD_SCOPED_BUDGET_BYTES // (4 * max(1, k_padded))) & ~7)
+
+
 def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
     """Solve ``a[i] @ x[i] = b[i]`` for a batch of SPD k×k systems.
 
@@ -149,12 +171,7 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
     n, k = b.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # VMEM per tile ≈ several live buffers of tile_b·k·(k+1)·4B each (the
-    # augmented scratch has k+1 lanes — at k=128 that pads to 256, not 128),
-    # where dims pad to (8-sublane, 128-lane) tiles on TPU; the scoped-vmem
-    # stack limit is 16 MB, so budget ~4 MB for the largest buffer
-    k_padded = _pad_dim(k, 8) * _pad_dim(k + 1, _LANE)
-    tile_b = min(256, ((7 << 17) // max(1, k_padded)) & ~7)
+    tile_b = spd_tile_b(k)
     if tile_b < 8:
         # k so large (~>=300 features with this budget) that even an 8-row
         # tile risks overflowing the scoped-VMEM stack: fall back to XLA's
@@ -181,9 +198,18 @@ def spd_solve_batched(a, b, *, interpret: "bool | None" = None):
 # to hide one row's HBM latency behind the previous rows' copies, shallow
 # enough that the semaphore array stays trivially within hardware limits
 _GG_BUFS = 4
-# features past this would push the (k, k) output block + (T, k) gather
-# scratch toward the scoped-VMEM budget; callers fall back to the einsum
-# formulation (same numerics, more HBM traffic)
+# The pack's slot width T is a power of two in [8, 512] (train.py
+# _auto_slot_width) — the kernel's resident budget is evaluated at the cap.
+_GG_SLOT_WIDTH_MAX = 512
+# Features past this would push the kernel's resident VMEM state — the
+# double-buffered (1, k, k)/(1, k) accumulator blocks, the (T, k) gather
+# scratch, and the (1, T) weight blocks — past the resident-state budget
+# (oryx.analyze.kernel.resident-budget-bytes, 1.5 MB); callers fall back to
+# the einsum formulation (same numerics, more HBM traffic). The value is
+# the max k whose padded footprint at T = _GG_SLOT_WIDTH_MAX fits that
+# budget, pinned against the static kernel model by
+# tests/test_kernel_differential.py so the constant can never silently
+# drift from the kernel it guards.
 _GG_MAX_FEATURES = 256
 
 

@@ -18,6 +18,13 @@ from oryx_tpu.tools.analyze.checkers.perrowstore import PerRowNdarrayStoreChecke
 from oryx_tpu.tools.analyze.checkers.replicated import ReplicatedCollectiveChecker
 from oryx_tpu.tools.analyze.checkers.hosttransfer import HostDeviceTransferChecker
 from oryx_tpu.tools.analyze.checkers.dtypewidth import DtypeWideningChecker
+from oryx_tpu.tools.analyze.checkers.pallas import (
+    KernelAliasDisciplineChecker,
+    KernelIndexBoundsChecker,
+    KernelInterpretDefaultChecker,
+    KernelTileAlignmentChecker,
+    KernelVmemBudgetChecker,
+)
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -36,6 +43,11 @@ ALL_CHECKERS = (
     ReplicatedCollectiveChecker(),
     HostDeviceTransferChecker(),
     DtypeWideningChecker(),
+    KernelVmemBudgetChecker(),
+    KernelTileAlignmentChecker(),
+    KernelIndexBoundsChecker(),
+    KernelAliasDisciplineChecker(),
+    KernelInterpretDefaultChecker(),
 )
 
 #: checker id -> precision version, recorded per baseline entry so a

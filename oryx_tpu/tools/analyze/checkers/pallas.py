@@ -1,0 +1,413 @@
+"""The ``pallas`` checker family: static verification of Pallas kernels.
+
+Hand-written TPU kernels fail in ways no other layer does: a BlockSpec that
+walks past its operand reads garbage on chip while interpret mode (how the
+CPU test suite runs every kernel) bounds-checks and hides it; an output
+block revisited across grid steps without first-visit init accumulates into
+whatever VMEM held before; a VMEM footprint past the per-core budget fails
+to compile — or worse, the hand-derived gate guarding it drifts from the
+kernel it guards. These five checks ride the parsed kernel models
+(tools/analyze/kernelmodel.py):
+
+  * ``kernel-vmem-budget`` — resident footprint (padded blocks ×2 when
+    pipelined + scratch) against the per-core VMEM limit, naming the
+    dominant buffer. Symbolic kernels render in ``analyze --cost`` and are
+    pinned to their runtime gates by tests/test_kernel_differential.py.
+  * ``kernel-tile-alignment`` — concrete block tails against the
+    dtype-native tiling ((8,128) f32, (16,128) bf16, (32,128) int8):
+    pad-waste when the hardware rounds a dim up, hard misalignment when a
+    grid-varying map makes later blocks start mid-tile.
+  * ``kernel-index-bounds`` — index map × block shape against operand
+    extents over the grid: flags what it can PROVE out of bounds (concrete
+    arithmetic, or a positive constant offset past a proven-exact cover),
+    stays silent on what it cannot.
+  * ``kernel-alias-discipline`` — ``input_output_aliases`` shape/dtype
+    mismatches, and output blocks revisited across grid steps with neither
+    a donated alias input nor in-kernel zero-init (the accumulator-race
+    class: deterministic garbage on chip, zeros under interpret).
+  * ``kernel-interpret-default`` — wrappers whose ``interpret`` defaults
+    ``True`` (or hard-coded ``interpret=True`` calls): on TPU they silently
+    EMULATE the kernel instead of compiling it — the PR 6
+    ``spd_solve_batched`` fix class. ``None``-defaulted backend dispatch
+    and caller-threaded flags are the sanctioned shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from oryx_tpu.tools.analyze.kernelmodel import (
+    LANE,
+    SUBLANE,
+    budgets,
+    kernel_models,
+    kernel_param_name,
+    kernel_zeroes_param,
+    _dim_value,
+    _operand_dtype,
+)
+
+VMEM_ID = "kernel-vmem-budget"
+TILE_ID = "kernel-tile-alignment"
+BOUNDS_ID = "kernel-index-bounds"
+ALIAS_ID = "kernel-alias-discipline"
+INTERPRET_ID = "kernel-interpret-default"
+
+
+class KernelVmemBudgetChecker:
+    id = VMEM_ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        limit = budgets()["vmem_limit_bytes"]
+        for model in kernel_models(project):
+            total = model.vmem_bytes({})
+            if total is None or total <= limit:
+                continue
+            worst, worst_bytes = None, 0.0
+            for b in model.vmem_buffers():
+                size = (b.padded_bytes({}) or 0.0) * (2.0 if b.pipelined
+                                                      else 1.0)
+                if size > worst_bytes:
+                    worst, worst_bytes = b, size
+            detail = ""
+            if worst is not None:
+                shape = "×".join(str(d) for d in worst.shape)
+                detail = (f" — dominated by the ({shape}) "
+                          f"{worst.dtype or 'float32'} {worst.kind} block "
+                          f"({worst_bytes / 1024.0:.0f} KiB"
+                          + (" double-buffered)" if worst.pipelined else ")"))
+            out.append(model.fctx.finding(
+                VMEM_ID, model.call,
+                f"kernel `{model.name}` needs {total / (1 << 20):.1f} MiB of "
+                f"VMEM resident per grid step, past the {limit >> 20} MiB "
+                f"per-core limit{detail} — shrink the block tile or spill "
+                "to HBM (pltpu.ANY + manual DMA)",
+                symbol=f"{model.name}:vmem",
+            ))
+        return out
+
+
+class KernelTileAlignmentChecker:
+    id = TILE_ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        for model in kernel_models(project):
+            for b in model.vmem_buffers():
+                if not b.shape:
+                    continue
+                dims = [_dim_value(d, {}) for d in b.shape]
+                sub = SUBLANE.get(b.dtype or "float32", 8)
+                # (dim position from the end, required multiple, axis name)
+                checks = [(1, LANE, "lane")]
+                if len(dims) >= 2:
+                    checks.append((2, sub, "sublane"))
+                for back, mult, axis in checks:
+                    d = dims[-back]
+                    # size-1 dims are the per-step row-select idiom (the
+                    # hardware broadcasts them); symbolic dims are the
+                    # wrapper-padded case — neither is checkable here
+                    if d is None or d <= 1 or d % mult == 0:
+                        continue
+                    padded = ((d + mult - 1) // mult) * mult
+                    waste = 100.0 * (padded - d) / padded
+                    varies = bool(
+                        b.index_map
+                        and len(b.index_map) >= back
+                        and b.index_map[-back][0] != "const"
+                    )
+                    if varies:
+                        msg = (
+                            f"kernel `{model.name}`: the {axis} dim of the "
+                            f"({'×'.join(str(x) for x in b.shape)}) "
+                            f"{b.kind} block is {d}, not a multiple of the "
+                            f"{b.dtype or 'float32'} tile ({mult}), and its "
+                            "index map varies along that dim — every block "
+                            "past the first starts mid-tile (Mosaic "
+                            "hard-misalignment); pad the block to the tile"
+                        )
+                    else:
+                        msg = (
+                            f"kernel `{model.name}`: the {axis} dim of the "
+                            f"({'×'.join(str(x) for x in b.shape)}) "
+                            f"{b.kind} block is {d}; the "
+                            f"{b.dtype or 'float32'} tile rounds it up to "
+                            f"{padded} ({waste:.0f}% of the block's VMEM "
+                            "and bandwidth is padding) — pad the dim in the "
+                            "wrapper or fold it into a tiled axis"
+                        )
+                    out.append(model.fctx.finding(
+                        TILE_ID, b.spec_node, msg,
+                        symbol=f"{model.name}:{b.kind}{b.index}:{axis}",
+                    ))
+        return out
+
+
+_FLOORDIV_RE = re.compile(r"^(.+?)\s*//\s*(.+)$")
+
+
+def _covered_extent(comp, block_dim, grid):
+    """The extent a map component × block dim provably covers, as
+    ``(kind, value)``: ("int", n) when concrete, ("sym", expr) when the
+    ``(A // B) · B`` pattern telescopes to exactly ``A`` or the block covers
+    one symbolic stride, plus a ("sym_over", expr) variant for a positive
+    constant offset PAST that proven-exact cover. None = unprovable."""
+    bd_int = _dim_value(block_dim, {}) if not isinstance(block_dim, int) \
+        else block_dim
+
+    def scaled(grid_extent, offset_blocks):
+        g_int = grid_extent if isinstance(grid_extent, int) else None
+        if g_int is not None and bd_int is not None:
+            return ("int", (g_int + offset_blocks) * bd_int)
+        if isinstance(grid_extent, str):
+            m = _FLOORDIV_RE.match(grid_extent)
+            if m:
+                a, b_expr = m.group(1).strip(), m.group(2).strip()
+                if str(block_dim) == b_expr:
+                    # (A // B) blocks of B rows cover at most A rows
+                    if offset_blocks == 0:
+                        return ("sym", a)
+                    return ("sym_over", a)
+            if bd_int == 1 and offset_blocks == 0:
+                return ("sym", grid_extent)
+        return None
+
+    if comp[0] == "const":
+        if bd_int is not None:
+            return ("int", (comp[1] + 1) * bd_int)
+        if comp[1] == 0:
+            return ("sym", str(block_dim))
+        return None
+    if comp[0] == "grid" and comp[1] < len(grid):
+        return scaled(grid[comp[1]], 0)
+    if comp[0] == "grid+" and comp[1] < len(grid):
+        res = scaled(grid[comp[1]], comp[2])
+        if res and res[0] == "int":
+            return res
+        if res and res[0] == "sym":
+            return ("sym_over", res[1])
+        return res
+    return None
+
+
+class KernelIndexBoundsChecker:
+    id = BOUNDS_ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        for model in kernel_models(project):
+            shape_of = model.senv.get("__shape_of__")
+            for b in (*model.inputs, *model.outputs):
+                if not (b.shape and b.index_map):
+                    continue
+                operand_shape = None
+                if b.kind == "out":
+                    if b.index < len(model.out_shapes):
+                        operand_shape = model.out_shapes[b.index][0]
+                else:
+                    pos = model.num_prefetch + b.index
+                    if shape_of and pos < len(model.operands):
+                        operand_shape = shape_of(model.operands[pos])
+                if operand_shape is None:
+                    continue
+                for d, comp in enumerate(b.index_map):
+                    if d >= len(b.shape) or d >= len(operand_shape):
+                        break
+                    cover = _covered_extent(comp, b.shape[d], model.grid)
+                    if cover is None:
+                        continue
+                    od = operand_shape[d]
+                    od_int = od if isinstance(od, int) else _dim_value(od, {})
+                    kind, val = cover
+                    oob = None
+                    if kind == "int" and od_int is not None:
+                        if val > od_int:
+                            oob = f"{val} > {od_int}"
+                    elif kind == "sym_over" and str(od) == str(val):
+                        oob = (f"at least one block past the `{val}` extent "
+                               "(positive index-map offset)")
+                    if oob:
+                        out.append(model.fctx.finding(
+                            BOUNDS_ID, b.spec_node,
+                            f"kernel `{model.name}`: dim {d} of the "
+                            f"{b.kind}[{b.index}] block reaches "
+                            f"{oob} past operand `{b.label}` over the grid "
+                            f"({'×'.join(str(g) for g in model.grid)}) — an "
+                            "out-of-bounds read/write that interpret mode "
+                            "clamps but real hardware does not",
+                            symbol=f"{model.name}:{b.kind}{b.index}:d{d}",
+                        ))
+        return out
+
+
+class KernelAliasDisciplineChecker:
+    id = ALIAS_ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        for model in kernel_models(project):
+            shape_of = model.senv.get("__shape_of__")
+            aliased_outs = set(model.aliases.values())
+            # -- alias shape/dtype agreement -------------------------------
+            for in_pos, out_idx in model.aliases.items():
+                if out_idx >= len(model.out_shapes):
+                    continue
+                o_shape, o_dtype = model.out_shapes[out_idx]
+                if in_pos >= len(model.operands):
+                    continue
+                operand = model.operands[in_pos]
+                i_shape = shape_of(operand) if shape_of else None
+                label = ast.unparse(operand)[:40]
+                if (i_shape is not None and o_shape is not None
+                        and tuple(map(str, i_shape)) != tuple(map(str, o_shape))):
+                    out.append(model.fctx.finding(
+                        ALIAS_ID, model.call,
+                        f"kernel `{model.name}`: input_output_aliases donates "
+                        f"`{label}` ({'×'.join(map(str, i_shape))}) to output "
+                        f"{out_idx} ({'×'.join(map(str, o_shape))}) — aliased "
+                        "buffers must agree exactly; a mismatch is silent "
+                        "memory corruption on chip",
+                        symbol=f"{model.name}:alias{in_pos}:shape",
+                    ))
+                i_dtype = _operand_dtype(model.fctx, model.enclosing, operand)
+                if i_dtype and o_dtype and i_dtype != o_dtype:
+                    out.append(model.fctx.finding(
+                        ALIAS_ID, model.call,
+                        f"kernel `{model.name}`: input_output_aliases donates "
+                        f"`{label}` ({i_dtype}) to output {out_idx} "
+                        f"({o_dtype}) — dtype-mismatched aliasing "
+                        "reinterprets bytes",
+                        symbol=f"{model.name}:alias{in_pos}:dtype",
+                    ))
+            # -- revisited outputs need donated or in-kernel init ----------
+            for b in model.outputs:
+                if b.space != "vmem" or not b.revisits_across_grid(model.grid):
+                    continue
+                if b.index in aliased_outs:
+                    continue
+                pname = kernel_param_name(model, "out", b.index)
+                if kernel_zeroes_param(model, pname):
+                    continue
+                out.append(model.fctx.finding(
+                    ALIAS_ID, b.spec_node,
+                    f"kernel `{model.name}`: output {b.index}'s block is "
+                    "revisited across grid steps but is neither "
+                    "alias-donated (input_output_aliases) nor zero-"
+                    "initialized inside the kernel (pl.when first-visit "
+                    "store) — on chip the first accumulation reads whatever "
+                    "VMEM held, while interpret mode shows clean zeros (the "
+                    "accumulator-race class)",
+                    symbol=f"{model.name}:out{b.index}:init",
+                ))
+        return out
+
+
+class KernelInterpretDefaultChecker:
+    id = INTERPRET_ID
+    version = 1
+
+    def check(self, project) -> list:
+        out = []
+        # functions that thread a caller-decided interpret-carrying
+        # parameter (whatever it is NAMED) into a pallas_call — directly,
+        # or through another threading function — mapped key -> that
+        # parameter's name. A default of True anywhere on the chain
+        # silently emulates on TPU.
+        threading: dict = {}
+        for model in kernel_models(project):
+            if model.interpret is None:
+                continue
+            kind, val = model.interpret
+            if kind == "literal" and val is True:
+                out.append(model.fctx.finding(
+                    INTERPRET_ID, model.call,
+                    f"kernel `{model.name}`: hard-coded interpret=True — on "
+                    "TPU this silently EMULATES the kernel at Python speed "
+                    "instead of compiling it; thread the caller's platform "
+                    "decision (interpret=<param>) or resolve None via "
+                    "jax.default_backend()",
+                    symbol=f"{model.name}:interpret:literal",
+                ))
+            elif kind == "param" and model.enclosing is not None:
+                key = (model.fctx.relpath,
+                       model.fctx.qualname_of.get(model.enclosing))
+                threading[key] = val
+
+        def param_default(fn, name):
+            a = fn.args
+            pos = a.posonlyargs + a.args
+            defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+            for p, d in zip(pos, defaults):
+                if p.arg == name:
+                    return d
+            for p, d in zip(a.kwonlyargs, a.kw_defaults):
+                if p.arg == name:
+                    return d
+            return None
+
+        graph = project.call_graph()
+        for _ in range(3):  # close over wrapper-of-wrapper chains
+            grew = False
+            for key, (fctx, fn) in graph.functions.items():
+                if key in threading:
+                    continue
+                a = fn.args
+                params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+                if not params:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee_name = None
+                    if isinstance(node.func, ast.Name):
+                        callee_name = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        callee_name = node.func.attr
+                    # the callee's threading param arrives as the kwarg of
+                    # the same name; whichever of MY params feeds it makes
+                    # me a threading function under MY param's name
+                    tp_names = {
+                        pname for (_, qual), pname in threading.items()
+                        if qual and qual.split(".")[-1] == callee_name
+                    }
+                    mine = None
+                    for kw in node.keywords:
+                        if kw.arg not in tp_names:
+                            continue
+                        fed = sorted(
+                            x.id for x in ast.walk(kw.value)
+                            if isinstance(x, ast.Name) and x.id in params
+                        )
+                        if fed:
+                            # prefer a same-named param; else deterministic
+                            mine = kw.arg if kw.arg in fed else fed[0]
+                            break
+                    if mine is not None:
+                        threading[key] = mine
+                        grew = True
+                        break
+            if not grew:
+                break
+
+        for key, pname in threading.items():
+            fctx, fn = graph.functions.get(key, (None, None))
+            if fn is None:
+                continue
+            default = param_default(fn, pname)
+            if (isinstance(default, ast.Constant) and default.value is True):
+                out.append(fctx.finding(
+                    INTERPRET_ID, fn,
+                    f"`{key[1]}` threads `{pname}` into a Pallas kernel's "
+                    "interpret flag but DEFAULTS it to True — every caller "
+                    "that forgets the flag emulates the kernel on TPU at "
+                    "Python speed, silently; default to None and resolve "
+                    "from jax.default_backend(), or make the flag required",
+                    symbol=f"{key[1]}:interpret:default",
+                ))
+        return out

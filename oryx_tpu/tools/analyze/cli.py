@@ -98,15 +98,18 @@ def _fmt_cost(poly, bindings: dict) -> str:
 
 def _cost_main(paths, root, args) -> int:
     """``analyze --cost``: the static roofline table — per-jit-program
-    FLOPs / HBM bytes / collective bytes from the abstract shapes, to diff
-    in review before anything runs on chip (the static twin of the runtime
-    CostRegistry in common/profiling.py)."""
+    FLOPs / HBM bytes / collective bytes from the abstract shapes, plus a
+    per-Pallas-kernel section (resident VMEM footprint + per-grid-step HBM
+    block traffic), to diff in review before anything runs on chip (the
+    static twin of the runtime CostRegistry in common/profiling.py)."""
     from oryx_tpu.tools.analyze.core import build_project
     from oryx_tpu.tools.analyze.dataflow import cost_report
+    from oryx_tpu.tools.analyze.kernelmodel import kernel_cost_report
 
     bindings = _parse_bindings(args.bind)
     project, errors = build_project(paths, root)
     rows = cost_report(project)
+    kernel_rows = kernel_cost_report(project, bindings)
     if args.format == "json":
         payload = []
         for r in rows:
@@ -120,7 +123,24 @@ def _cost_main(paths, root, args) -> int:
                     "value": poly.evaluate(bindings) if bindings else None,
                 }
             payload.append(entry)
-        print(json.dumps({"programs": payload, "bindings": bindings,
+        kpayload = []
+        for r in kernel_rows:
+            kpayload.append({
+                "kernel": r["kernel"], "path": r["path"], "line": r["line"],
+                "grid": r["grid"],
+                # expr is the unpadded symbolic form; value applies the
+                # dtype-native tiling pads, so value >= expr evaluated
+                "vmem_bytes": {
+                    "expr": r["vmem_bytes"].render(),
+                    "value": r["vmem_bytes_value"],
+                },
+                "hbm_bytes_per_step": {
+                    "expr": r["hbm_bytes_per_step"].render(),
+                    "value": r["hbm_bytes_per_step_value"],
+                },
+            })
+        print(json.dumps({"programs": payload, "kernels": kpayload,
+                          "bindings": bindings,
                           "parse_errors": errors}, indent=2))
     else:
         header = f"{'program':58s} {'flops':>24s} {'hbm_bytes':>24s} {'collective_bytes':>24s}"
@@ -133,6 +153,21 @@ def _cost_main(paths, root, args) -> int:
                   f"{_fmt_cost(r['collective_bytes'], bindings)[:24]:>24s}")
         print(f"{len(rows)} jit program(s)"
               + (f", bound: {bindings}" if bindings else ""))
+        if kernel_rows:
+            print()
+            kheader = (f"{'pallas kernel':44s} {'grid':>14s} "
+                       f"{'vmem_bytes':>30s} {'hbm_bytes/step':>24s}")
+            print(kheader)
+            print("-" * len(kheader))
+            for r in kernel_rows:
+                vm = (f"{r['vmem_bytes_value']:,.0f}"
+                      if r["vmem_bytes_value"] is not None
+                      else r["vmem_bytes"].render())
+                print(f"{r['kernel'][:44]:44s} {r['grid'][:14]:>14s} "
+                      f"{vm[:30]:>30s} "
+                      f"{_fmt_cost(r['hbm_bytes_per_step'], bindings)[:24]:>24s}")
+            print(f"{len(kernel_rows)} pallas kernel(s) — vmem = padded "
+                  "resident footprint (pipelined blocks ×2 + scratch)")
         for err in errors:
             print(f"PARSE ERROR: {err}", file=sys.stderr)
     return 2 if errors else 0
@@ -145,8 +180,10 @@ def main(argv: "list[str] | None" = None) -> int:
         "(tracer leaks, recompile hazards, blocking-in-async, lock "
         "discipline, lock-order cycles, blocking-under-lock, shared-state "
         "escapes, config-key drift, float64 promotion, replicated "
-        "collectives, host-device transfers, dtype widening) plus the "
-        "--cost static roofline",
+        "collectives, host-device transfers, dtype widening, and the "
+        "Pallas kernel family: VMEM budget, tile alignment, index-map "
+        "bounds, alias discipline, interpret defaults) plus the --cost "
+        "static roofline with per-kernel VMEM rows",
     )
     parser.add_argument(
         "paths", nargs="*",

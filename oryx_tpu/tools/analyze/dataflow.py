@@ -139,7 +139,10 @@ class Poly:
             for s in key:
                 seen[s] = seen.get(s, 0) + 1
             for s, p in sorted(seen.items()):
-                syms.append(s if p == 1 else f"{s}^{p}")
+                # expression symbols ("k + 1") read as separate terms when
+                # joined bare into a product — parenthesize them
+                disp = f"({s})" if any(c in s for c in " +-*/") else s
+                syms.append(disp if p == 1 else f"{disp}^{p}")
             body = "·".join(syms)
             if coeff == 1.0 and body:
                 parts.append(body)

@@ -504,6 +504,18 @@ def render_batch_record(payload: dict, out=None) -> int:
             if v is None:
                 continue
             w(f"  {phase:<8} {v:8.3f}s  {100.0 * v / total:5.1f}%\n")
+    kernels = [kr for kr in rec.get("kernels") or [] if "kernel" in kr]
+    if kernels:
+        w("pallas kernel VMEM (static model, at this bench's shapes):\n")
+        for kr in kernels:
+            vm = kr.get("vmem_bytes")
+            vm_s = (f"{vm / 1024.0:10,.0f} KiB" if isinstance(
+                vm, (int, float)) else f"{kr.get('vmem_expr', '?'):>14s}")
+            hbm = kr.get("hbm_bytes_per_step")
+            hbm_s = (f"{hbm / 1024.0:,.0f} KiB/step"
+                     if isinstance(hbm, (int, float)) else "-")
+            w(f"  {kr.get('kernel', '?'):<28} grid {kr.get('grid', '-'):<16}"
+              f" {vm_s}  ({hbm_s})\n")
     e2e = rec.get("train_e2e")
     if e2e:
         w("pack/compute overlap (als_train end-to-end):\n")

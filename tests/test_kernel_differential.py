@@ -1,0 +1,267 @@
+"""Differential kernel fuzz gate (ISSUE 15).
+
+The static verifier (tests/test_kernel_verifier.py) proves structure; this
+harness proves NUMBERS, two ways:
+
+  * **differential fuzz** — a seeded (``random.Random``, no wall-clock
+    nondeterminism) shape/dtype matrix drives both trainer kernels —
+    ``spd_solve_batched`` and ``gather_gramian_accumulate`` — under
+    ``interpret=True`` against plain numpy references, across the edge
+    shapes that bite on chip: single-row batches, batch sizes straddling
+    the pad tile, k at the VMEM budget boundary, empty rows, pad slots,
+    single-slot grids, skewed slot fill, bf16 inputs. Zero-input regions
+    must come back BITWISE zero (the donated-alias contract); everything
+    else within accumulation tolerance.
+
+  * **budget consistency** — the runtime gates (``_GG_MAX_FEATURES``, the
+    ``spd_tile_b`` batch-tile formula) are recomputed from the PARSED
+    kernel models (tools/analyze/kernelmodel.py) under the registered
+    ``oryx.analyze.kernel.*`` budgets and asserted EQUAL. The hand-derived
+    constants in ops/pallas_kernels.py can no longer silently drift from
+    the kernels they guard: add a scratch buffer or grow a block and this
+    file fails until both sides are re-derived.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import oryx_tpu
+from oryx_tpu.ops import pallas_kernels as pk
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(oryx_tpu.__file__)))
+SEED = 0x0F15
+
+
+# ---------------------------------------------------------------------------
+# spd_solve_batched vs LAPACK
+# ---------------------------------------------------------------------------
+
+
+def _spd_cases():
+    """The seeded shape matrix: every k-class the tile formula produces
+    (full 256-tile, mid tiles, the 8-row boundary tile at k=296, and the
+    cholesky fallback past it) × batch sizes around the pad tile."""
+    rng = random.Random(SEED)
+    cases = []
+    for k in (1, 2, 5, 8, 13, 50, 64):
+        b = rng.choice((1, 2, 7, 9, 33))
+        cases.append((b, k))
+    cases.append((257, 50))   # straddles the k=50 tile (tile_b=128)
+    cases.append((2, 296))    # the LAST kernel k: tile_b == 8
+    cases.append((2, 304))    # first fallback k: cholesky path
+    return cases
+
+
+@pytest.mark.parametrize("b,k", _spd_cases())
+def test_spd_differential_matches_numpy(b, k):
+    rng = np.random.default_rng(SEED + 1000 * b + k)
+    m = rng.standard_normal((b, k, k)).astype(np.float32) * 0.3
+    a = np.einsum("bij,bkj->bik", m, m) + 2.0 * np.eye(k, dtype=np.float32)
+    rhs = rng.standard_normal((b, k)).astype(np.float32)
+    x = np.asarray(pk.spd_solve_batched(a, rhs, interpret=True))
+    ref = np.stack([np.linalg.solve(a[i], rhs[i]) for i in range(b)])
+    err = np.abs(x - ref).max() / max(1e-9, np.abs(ref).max())
+    tol = 1e-4 if k < 100 else 1e-3
+    assert x.shape == (b, k) and np.isfinite(x).all()
+    assert err < tol, (b, k, err)
+
+
+def test_spd_boundary_tile_is_the_modeled_boundary():
+    """The (2, 296) case above really did run at the smallest legal tile,
+    and 304 really fell back — the fuzz matrix covers the budget boundary,
+    not just round shapes."""
+    assert pk.spd_tile_b(296) == 8
+    assert pk.spd_tile_b(304) < 8
+
+
+# ---------------------------------------------------------------------------
+# gather_gramian_accumulate vs numpy
+# ---------------------------------------------------------------------------
+
+
+def _gg_layout(rng, block, t, n_slots, n_pad_slots, skew):
+    """A sorted slotted layout: real slots over a random subset of rows
+    (guaranteeing empty rows), pad slots (owner = spill row, len 0) at the
+    end, slot fill skewed when asked (mostly-empty slots plus full ones)."""
+    owners = sorted(rng.choices(range(block), k=n_slots))
+    srow = np.array(owners + [block] * n_pad_slots, dtype=np.int32)
+    s = len(srow)
+    slens = np.zeros(s, dtype=np.int32)
+    for i in range(n_slots):
+        if skew and rng.random() < 0.5:
+            slens[i] = rng.choice((0, 1, t))
+        else:
+            slens[i] = rng.randint(0, t)
+    return srow, slens
+
+
+def _gg_reference(y, srow, scols, w, coef, block):
+    yg = y[scols]  # (S, T, k)
+    ra = np.zeros((block + 1, y.shape[1], y.shape[1]), np.float32)
+    rb = np.zeros((block + 1, y.shape[1]), np.float32)
+    np.add.at(ra, srow, np.einsum("st,sti,stj->sij", w, yg, yg))
+    np.add.at(rb, srow, np.einsum("st,sti->si", coef, yg))
+    return ra, rb
+
+
+def _gg_cases():
+    rng = random.Random(SEED + 7)
+    cases = []
+    for k, t, block, n_slots, n_pad, skew in (
+        (4, 1, 8, 3, 2, False),     # T=1: one entry per slot
+        (8, 4, 16, 1, 0, False),    # single-slot grid
+        (8, 8, 32, 12, 4, True),    # skewed fill, pad slots
+        (13, 7, 8, 5, 3, True),     # nothing tile-round anywhere
+        (50, 8, 64, 20, 4, False),  # the production k
+        (256, 4, 2, 3, 1, False),   # k AT the resident-budget boundary
+    ):
+        cases.append((k, t, block, n_slots, n_pad, skew,
+                      rng.randrange(1 << 16)))
+    return cases
+
+
+@pytest.mark.parametrize("k,t,block,n_slots,n_pad,skew,case_seed", _gg_cases())
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_gg_differential_matches_numpy(k, t, block, n_slots, n_pad, skew,
+                                       case_seed, dtype):
+    if dtype == "bfloat16" and k == 256:
+        pytest.skip("one boundary run is enough; bf16 covered at small k")
+    rng = random.Random(case_seed)
+    nrng = np.random.default_rng(case_seed)
+    srow, slens = _gg_layout(rng, block, t, n_slots, n_pad, skew)
+    s = len(srow)
+    n_opp = max(2 * k, 16)
+    scols = np.sort(nrng.integers(0, n_opp, (s, t)), axis=1).astype(np.int32)
+    mask = (np.arange(t)[None, :] < slens[:, None]).astype(np.float32)
+    w = (nrng.standard_normal((s, t)).astype(np.float32) * mask)
+    coef = (nrng.standard_normal((s, t)).astype(np.float32) * mask)
+    y = nrng.standard_normal((n_opp, k)).astype(np.float32)
+
+    yj = jnp.asarray(y)
+    if dtype == "bfloat16":
+        yj = yj.astype(jnp.bfloat16)
+        # the kernel contracts bf16×bf16→f32; reference uses the SAME
+        # rounded operands so only accumulation order differs
+        y_ref = np.asarray(yj.astype(jnp.float32))
+        w_ref = np.asarray(jnp.asarray(w).astype(jnp.bfloat16)
+                           .astype(jnp.float32)) * mask
+        coef_ref = np.asarray(jnp.asarray(coef).astype(jnp.bfloat16)
+                              .astype(jnp.float32)) * mask
+        tol = 2e-2
+    else:
+        y_ref, w_ref, coef_ref, tol = y, w, coef, 1e-4
+
+    big_a, big_b = jax.jit(
+        lambda *args: pk.gather_gramian_accumulate(
+            *args, block=block, interpret=True)
+    )(yj, jnp.asarray(srow), jnp.asarray(scols), jnp.asarray(w),
+      jnp.asarray(coef), jnp.asarray(slens))
+    big_a, big_b = np.asarray(big_a), np.asarray(big_b)
+
+    ra, rb = _gg_reference(y_ref, srow, scols, w_ref, coef_ref, block)
+    scale = max(1e-9, np.abs(ra).max(), np.abs(rb).max())
+    assert np.abs(big_a - ra).max() / scale < tol, (k, t, block)
+    assert np.abs(big_b - rb).max() / scale < tol, (k, t, block)
+
+    # the donated-alias contract, BITWISE: rows no slot names return exact
+    # zeros, not accumulation noise
+    touched = set(srow.tolist())
+    for r in range(block + 1):
+        if r not in touched:
+            assert not big_a[r].any() and not big_b[r].any(), r
+
+
+def test_gg_supported_gate_spans_the_fuzz_matrix():
+    """Every kernel-run case above sits inside the runtime gate, and the
+    matrix's boundary case IS the gate's last legal k."""
+    ks = [c[0] for c in _gg_cases()]
+    assert all(pk.gather_gramian_supported(k) for k in ks)
+    assert max(ks) == pk._GG_MAX_FEATURES
+
+
+# ---------------------------------------------------------------------------
+# budget consistency: the static model IS the runtime gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ops_kernel_models():
+    from oryx_tpu.tools.analyze.core import build_project
+    from oryx_tpu.tools.analyze.kernelmodel import kernel_models
+
+    project, errors = build_project(
+        [os.path.join(REPO_ROOT, "oryx_tpu", "ops", "pallas_kernels.py")],
+        root=REPO_ROOT,
+    )
+    assert errors == []
+    return {m.name: m for m in kernel_models(project)}
+
+
+def test_gg_max_features_equals_modeled_budget(ops_kernel_models):
+    """THE drift gate: ``_GG_MAX_FEATURES`` must equal the largest k whose
+    parsed, tile-padded resident footprint at the pack's maximum slot width
+    fits the registered resident budget — and the runtime boolean gate must
+    agree with the model at EVERY k, so neither side can move alone."""
+    from oryx_tpu.tools.analyze.kernelmodel import budgets
+
+    gg = ops_kernel_models["gather_gramian_accumulate"]
+    budget = budgets()["resident_budget_bytes"]
+
+    def fits(k: int) -> bool:
+        nbytes = gg.vmem_bytes({"k": k, "t": pk._GG_SLOT_WIDTH_MAX})
+        assert nbytes is not None, "gg model no longer evaluates — reparse"
+        return nbytes <= budget
+
+    modeled_max = max(k for k in range(8, 1025, 8) if fits(k))
+    assert modeled_max == pk._GG_MAX_FEATURES
+    for k in (1, 7, 8, 50, 200, 249, 255, 256, 257, 264, 300, 511, 512):
+        assert pk.gather_gramian_supported(k) == fits(k), k
+
+
+def test_spd_tile_formula_equals_modeled_budget(ops_kernel_models):
+    """``spd_tile_b``'s hand math (pad8(k)·pad128(k+1) elements against the
+    scoped budget) must match the parsed model's largest-single-buffer
+    bytes — the augmented (tile_b, k, k+1) scratch — at every k, including
+    the 8-row boundary and the fallback region."""
+    from oryx_tpu.tools.analyze.kernelmodel import budgets
+
+    spd = ops_kernel_models["_spd_solve_call"]
+    scoped = budgets()["scoped_budget_bytes"]
+
+    def modeled_tile(k: int) -> int:
+        for tb in range(pk._SPD_MAX_TILE, 0, -8):
+            nbytes = spd.max_buffer_bytes({"tile_b": tb, "k": k})
+            assert nbytes is not None, "spd model no longer evaluates"
+            if nbytes <= scoped:
+                return tb
+        return 0
+
+    for k in (1, 2, 8, 13, 50, 64, 100, 127, 128, 200, 256, 288, 296, 304,
+              350, 480):
+        assert pk.spd_tile_b(k) == modeled_tile(k), k
+
+
+def test_budget_knobs_registered_and_defaults_agree():
+    """The ``oryx.analyze.kernel.*`` keys exist in reference_conf and their
+    registered defaults equal the module constants the checkers use when no
+    config is loaded — one budget surface, not two."""
+    from oryx_tpu.common.config import Config
+    from oryx_tpu.common.reference_conf import REFERENCE_CONF
+    from oryx_tpu.tools.analyze.kernelmodel import budgets
+
+    conf = Config.parse_string(REFERENCE_CONF)
+    b = budgets(conf)
+    assert conf.get_int("oryx.analyze.kernel.vmem-limit-bytes") \
+        == b["vmem_limit_bytes"] == 16 << 20
+    assert conf.get_int("oryx.analyze.kernel.scoped-budget-bytes") \
+        == b["scoped_budget_bytes"] == pk._SPD_SCOPED_BUDGET_BYTES
+    assert conf.get_int("oryx.analyze.kernel.resident-budget-bytes") \
+        == b["resident_budget_bytes"] == 1536 << 10
