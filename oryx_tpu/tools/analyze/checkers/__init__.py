@@ -25,6 +25,7 @@ from oryx_tpu.tools.analyze.checkers.pallas import (
     KernelTileAlignmentChecker,
     KernelVmemBudgetChecker,
 )
+from oryx_tpu.tools.analyze.checkers.protocolmodel import ProtocolModelDriftChecker
 
 ALL_CHECKERS = (
     JitRecompileChecker(),
@@ -48,6 +49,7 @@ ALL_CHECKERS = (
     KernelIndexBoundsChecker(),
     KernelAliasDisciplineChecker(),
     KernelInterpretDefaultChecker(),
+    ProtocolModelDriftChecker(),
 )
 
 #: checker id -> precision version, recorded per baseline entry so a

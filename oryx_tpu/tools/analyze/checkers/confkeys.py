@@ -169,37 +169,39 @@ class ConfigKeyDriftChecker:
         return "oryx_tpu/common/reference_conf.py"
 
     def _collect_file(self, fctx, strict, loose_literals, loose_patterns) -> None:
-        # loose references (excluding docstrings)
+        # One walk gathers everything; getter calls are replayed after so
+        # prefix tracking still sees assignments that follow a use site.
+        # (ast.walk is breadth-first, so a scope node is always seen
+        # before its docstring Constant.)
         docstrings = set()
+        prefixes: dict[str, str] = {}
+        getter_calls: list = []
         for node in ast.walk(fctx.tree):
-            if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)):
-                body = getattr(node, "body", [])
-                if body and isinstance(body[0], ast.Expr) and isinstance(
-                    body[0].value, ast.Constant
+            if isinstance(node, ast.Constant):
+                if (
+                    isinstance(node.value, str)
+                    and node.value.startswith("oryx.")
+                    and node not in docstrings
                 ):
-                    docstrings.add(body[0].value)
-        for node in ast.walk(fctx.tree):
-            if (
-                isinstance(node, ast.Constant)
-                and isinstance(node.value, str)
-                and node.value.startswith("oryx.")
-                and node not in docstrings
-            ):
-                val = node.value.rstrip(".")
-                if "." in val:  # a bare "oryx" would prefix-mask every key
-                    loose_literals.add(val)
+                    val = node.value.rstrip(".")
+                    if "." in val:  # bare "oryx" would prefix-mask every key
+                        loose_literals.add(val)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GETTERS
+                    and node.args
+                ):
+                    getter_calls.append(node)
             elif isinstance(node, ast.JoinedStr):
                 p = _fstring_pattern(node)
                 if p:
                     loose_patterns.add(p)
-
-        # strict getter reads, with get_config-variable prefix tracking
-        prefixes: dict[str, str] = {}
-        for node in ast.walk(fctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            elif isinstance(node, ast.Assign):
                 call = node.value
                 if (
-                    isinstance(call.func, ast.Attribute)
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
                     and call.func.attr == "get_config"
                     and call.args
                     and isinstance(call.args[0], ast.Constant)
@@ -209,14 +211,17 @@ class ConfigKeyDriftChecker:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             prefixes[t.id] = call.args[0].value
-        for node in ast.walk(fctx.tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _GETTERS
-                and node.args
+            elif isinstance(
+                node,
+                (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
             ):
-                continue
+                body = getattr(node, "body", [])
+                if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant
+                ):
+                    docstrings.add(body[0].value)
+
+        for node in getter_calls:
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
                 key = arg.value

@@ -352,30 +352,52 @@ class KernelInterpretDefaultChecker:
             return None
 
         graph = project.call_graph()
-        for _ in range(3):  # close over wrapper-of-wrapper chains
-            grew = False
+        # the FileContext walk already indexed every keyword-bearing call
+        # under each enclosing function; the fixpoint rounds below then
+        # only touch calls whose callee name matches a known threading
+        # function
+        kwcalls_by_fn: "dict | None" = None
+        params_by_fn: dict = {}
+
+        def _index_calls():
+            calls_by_fn: dict = {}
             for key, (fctx, fn) in graph.functions.items():
-                if key in threading:
-                    continue
                 a = fn.args
                 params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
                 if not params:
                     continue
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    callee_name = None
+                entries = []
+                for node in fctx.kw_calls_by_qual.get(key[1], ()):
                     if isinstance(node.func, ast.Name):
-                        callee_name = node.func.id
+                        entries.append((node.func.id, node))
                     elif isinstance(node.func, ast.Attribute):
-                        callee_name = node.func.attr
-                    # the callee's threading param arrives as the kwarg of
-                    # the same name; whichever of MY params feeds it makes
-                    # me a threading function under MY param's name
-                    tp_names = {
-                        pname for (_, qual), pname in threading.items()
-                        if qual and qual.split(".")[-1] == callee_name
-                    }
+                        entries.append((node.func.attr, node))
+                if entries:
+                    params_by_fn[key] = params
+                    calls_by_fn[key] = entries
+            return calls_by_fn
+
+        for _ in range(3):  # close over wrapper-of-wrapper chains
+            grew = False
+            # the callee's threading param arrives as the kwarg of the
+            # same name; whichever of MY params feeds it makes me a
+            # threading function under MY param's name
+            tp_by_name: dict = {}
+            for (_, qual), pname in threading.items():
+                if qual:
+                    tp_by_name.setdefault(qual.split(".")[-1], set()).add(pname)
+            if not tp_by_name:
+                break
+            if kwcalls_by_fn is None:
+                kwcalls_by_fn = _index_calls()
+            for key, entries in kwcalls_by_fn.items():
+                if key in threading:
+                    continue
+                params = params_by_fn[key]
+                for callee_name, node in entries:
+                    tp_names = tp_by_name.get(callee_name)
+                    if not tp_names:
+                        continue
                     mine = None
                     for kw in node.keywords:
                         if kw.arg not in tp_names:

@@ -122,6 +122,8 @@ class JitRecompileChecker:
     def _check_jit_in_loop(self, fctx) -> list:
         """jax.jit(...) constructed inside a for/while body (fresh compile
         cache per iteration) unless the enclosing function is lru_cached."""
+        if "jit(" not in fctx.source:  # textual gate: skip the full walk
+            return []
         out = []
 
         def scan(node, in_loop: bool, cached: bool):
@@ -133,10 +135,10 @@ class JitRecompileChecker:
                     continue
                 child_in_loop = in_loop or isinstance(child, (ast.For, ast.While))
                 if (
-                    isinstance(child, ast.Call)
-                    and fctx.resolve(child.func) in ("jax.jit", "jax.pjit")
-                    and in_loop
+                    in_loop
                     and not cached
+                    and isinstance(child, ast.Call)
+                    and fctx.resolve(child.func) in ("jax.jit", "jax.pjit")
                 ):
                     out.append(fctx.finding(
                         ID, child,

@@ -173,6 +173,142 @@ def _cost_main(paths, root, args) -> int:
     return 2 if errors else 0
 
 
+def _protocol_replay(args) -> int:
+    """``analyze --protocol --schedule FIX.json``: replay a recorded
+    counterexample schedule against the fixture's own (buggy) variant AND
+    against HEAD, checking both outcomes against the fixture's
+    expectations. Exit 0 only when both match — the CI shape of the
+    regression fixtures under tests/data/protocol_schedules/."""
+    from oryx_tpu.tools.analyze import protocol as proto
+
+    try:
+        with open(args.schedule, "r", encoding="utf-8") as f:
+            fix = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"--schedule: cannot load {args.schedule}: {e}", file=sys.stderr)
+        return 2
+    try:
+        name = fix["model"]
+        schedule = fix["schedule"]
+    except KeyError as e:
+        print(f"--schedule: fixture is missing key {e}", file=sys.stderr)
+        return 2
+    variant = fix.get("variant", "")
+
+    runs = []  # (label, variant, expect_status, expect_invariant)
+    runs.append((variant or "HEAD", variant, fix.get("expect"),
+                 fix.get("invariant")))
+    if variant and fix.get("expect_at_head"):
+        # variant-only fixtures (schedules using actions HEAD does not
+        # have, e.g. the split recover_mark/recover_cut) omit this key
+        runs.append(("HEAD", "", fix["expect_at_head"], None))
+
+    rc = 0
+    payload = []
+    for label, var, expect, expect_inv in runs:
+        try:
+            model = proto.build_model(name, var)
+            result = proto.replay(model, schedule)
+        except (KeyError, ValueError) as e:
+            print(f"--schedule: {e}", file=sys.stderr)
+            return 2
+        got_inv = result.violation.invariant if result.violation else None
+        ok = (expect is None or result.status == expect) and (
+            expect_inv is None or got_inv == expect_inv
+        )
+        if not ok:
+            rc = 1
+        payload.append({
+            "against": label, "status": result.status, "step": result.step,
+            "action": result.action or None, "invariant": got_inv,
+            "expected": expect, "ok": ok,
+        })
+        if args.format != "json":
+            want = f" — expected {expect}" if expect else ""
+            verdict = "ok" if ok else "MISMATCH"
+            at = f" at step {result.step} ({result.action})" if result.step else ""
+            print(f"  {label}: {result.status}{at}{want} [{verdict}]")
+            if result.violation is not None:
+                print(proto.render_schedule(model, result.violation))
+    if args.format == "json":
+        print(json.dumps({"replay": {
+            "fixture": args.schedule, "model": name, "schedule": schedule,
+            "runs": payload,
+        }, "ok": rc == 0}, indent=2))
+    return rc
+
+
+def _protocol_main(args) -> int:
+    """``analyze --protocol``: exhaustively explore the transport protocol
+    state machines. Exit 0 when every model explores clean and complete,
+    1 on an invariant/liveness violation (with a minimized numbered
+    schedule), 2 when a time budget truncated the search."""
+    from oryx_tpu.tools.analyze import protocol as proto
+
+    if args.schedule:
+        return _protocol_replay(args)
+
+    if args.variant and not args.model:
+        print("--variant names a buggy variant of ONE model; pass --model",
+              file=sys.stderr)
+        return 2
+    names = [args.model] if args.model else list(proto.MODELS)
+    depth = args.depth if args.depth is not None else proto.TIER1_DEPTH
+    rc = 0
+    rows = []
+    for name in names:
+        try:
+            model = proto.build_model(name, args.variant or "")
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        res = proto.explore(
+            model, depth=depth, crash_budget=args.crash_budget,
+            time_budget=args.time_budget,
+        )
+        rows.append((model, res))
+        if not res.ok:
+            rc = 1
+        elif not res.complete:
+            rc = max(rc, 2)
+
+    if args.format == "json":
+        payload = []
+        for model, res in rows:
+            entry = {
+                "model": res.model, "variant": res.variant or None,
+                "depth": res.depth, "crash_budget": res.crash_budget,
+                "states": res.states, "transitions": res.transitions,
+                "elapsed_s": round(res.elapsed, 3),
+                "complete": res.complete, "ok": res.ok,
+            }
+            if res.violation is not None:
+                v = res.violation
+                entry["violation"] = {
+                    "invariant": v.invariant, "message": v.message,
+                    "schedule": list(v.schedule), "minimized": v.minimized,
+                }
+            payload.append(entry)
+        print(json.dumps({"protocol": payload, "ok": rc == 0}, indent=2))
+    else:
+        for model, res in rows:
+            if not res.ok:
+                status = f"VIOLATION {res.violation.invariant}"
+            elif not res.complete:
+                status = "INCOMPLETE (time budget hit — raise --time-budget)"
+            else:
+                status = "OK"
+            print(
+                f"{res.model:16s} variant={res.variant or 'HEAD':22s} "
+                f"depth={res.depth:2d} crash_budget={res.crash_budget} "
+                f"states={res.states:7d} transitions={res.transitions:8d} "
+                f"{res.elapsed:7.2f}s  {status}"
+            )
+            if res.violation is not None:
+                print(proto.render_schedule(model, res.violation))
+    return rc
+
+
 def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="oryx-run analyze",
@@ -228,6 +364,44 @@ def main(argv: "list[str] | None" = None) -> int:
         "--name-only HEAD` (plus untracked .py files) — the fast "
         "pre-commit mode; the call graph still spans the whole project",
     )
+    parser.add_argument(
+        "--protocol", action="store_true",
+        help="run the protocol model checker (exhaustive exploration of "
+        "the consumer-group / broker-append / checkpoint-generation "
+        "state machines) instead of the AST checkers",
+    )
+    parser.add_argument(
+        "--model", default=None, metavar="NAME",
+        help="with --protocol: explore only this model "
+        "(consumer-group | broker-append | ckpt-generation)",
+    )
+    parser.add_argument(
+        "--variant", default=None, metavar="NAME",
+        help="with --protocol --model: explore a buggy variant that "
+        "re-introduces a historically-fixed protocol bug (the explorer "
+        "should rediscover it and print the minimized schedule)",
+    )
+    parser.add_argument(
+        "--depth", type=int, default=None, metavar="N",
+        help="with --protocol: interleaving depth bound "
+        "(default: the tier-1 depth, 12)",
+    )
+    parser.add_argument(
+        "--crash-budget", type=int, default=2, metavar="N",
+        help="with --protocol: crash/restart steps allowed per run "
+        "(default 2)",
+    )
+    parser.add_argument(
+        "--time-budget", type=float, default=None, metavar="SECONDS",
+        help="with --protocol: cap exploration wall time; a truncated "
+        "search exits 2 instead of claiming a clean full exploration",
+    )
+    parser.add_argument(
+        "--schedule", default=None, metavar="FIXTURE.json",
+        help="with --protocol: replay a recorded counterexample schedule "
+        "fixture against its buggy variant AND against HEAD, checking "
+        "both expected outcomes (exit 0 only when both match)",
+    )
     args = parser.parse_args(argv)
 
     from oryx_tpu.tools.analyze.core import analyze_project, write_baseline
@@ -235,6 +409,43 @@ def main(argv: "list[str] | None" = None) -> int:
     default_paths, root = _default_paths()
     paths = args.paths or default_paths
     baseline_path = args.baseline or _default_baseline(root)
+    if args.protocol:
+        # model exploration has no findings/baseline/cost surface — refuse
+        # the other modes' flags instead of silently ignoring them
+        bad = [flag for flag, on in (
+            ("--cost", args.cost),
+            ("--changed", args.changed),
+            ("--update-baseline", args.update_baseline),
+            ("--checker", bool(args.checkers)),
+            ("--baseline", args.baseline is not None),
+            ("--no-baseline", args.no_baseline),
+            ("--bind", bool(args.bind)),
+            ("--format sarif", args.format == "sarif"),
+            ("PATHS", bool(args.paths)),
+        ) if on]
+        if bad:
+            print("--protocol explores the protocol models, not files or "
+                  f"findings; it does not combine with {', '.join(bad)}",
+                  file=sys.stderr)
+            return 2
+        if args.schedule and (
+            args.model or args.variant or args.depth is not None
+        ):
+            print("--schedule fixtures name their own model/variant and "
+                  "fix the step sequence; drop --model/--variant/--depth",
+                  file=sys.stderr)
+            return 2
+        return _protocol_main(args)
+    for flag, on in (
+        ("--model", args.model is not None),
+        ("--variant", args.variant is not None),
+        ("--depth", args.depth is not None),
+        ("--time-budget", args.time_budget is not None),
+        ("--schedule", args.schedule is not None),
+    ):
+        if on:
+            print(f"{flag} only applies to --protocol", file=sys.stderr)
+            return 2
     if args.cost:
         # refuse findings-mode flags instead of silently dropping them: an
         # operator typing `--cost --changed` would otherwise believe the

@@ -409,19 +409,14 @@ class FileContext:
 
     # -- imports / names ----------------------------------------------------
     def _collect(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    self.import_map[alias.asname or alias.name.split(".")[0]] = (
-                        alias.name if alias.asname else alias.name.split(".")[0]
-                    )
-            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
-                for alias in node.names:
-                    self.import_map[alias.asname or alias.name] = (
-                        f"{node.module}.{alias.name}"
-                    )
-        # qualnames via a scoped walk
-        def walk(node, prefix):
+        # One scoped traversal gathers imports, qualnames, and the Call
+        # nodes _collect_jit_scopes later inspects — a second full
+        # ast.walk per concern is the analyzer's hottest cost.
+        import_map = self.import_map
+        name_arg_calls: list = []
+        kw_calls_by_qual: dict = {}
+
+        def walk(node, prefix, fstack):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     qual = f"{prefix}{child.name}"
@@ -429,15 +424,45 @@ class FileContext:
                     self.functions_by_name.setdefault(child.name, []).append(child)
                     if isinstance(child, ast.AsyncFunctionDef):
                         self.async_functions.append((qual, child))
-                    walk(child, qual + ".")
+                    walk(child, qual + ".", fstack + (qual,))
                 elif isinstance(child, ast.ClassDef):
                     self.classes.append((f"{prefix}{child.name}", child))
-                    walk(child, f"{prefix}{child.name}.")
+                    walk(child, f"{prefix}{child.name}.", fstack)
                 else:
-                    walk(child, prefix)
+                    if isinstance(child, ast.Call):
+                        if child.args and isinstance(child.args[0], ast.Name):
+                            name_arg_calls.append(child)
+                        if child.keywords:
+                            # attributed to EVERY enclosing function level:
+                            # a closure's call can be fed by an outer
+                            # function's parameter
+                            for q in fstack:
+                                kw_calls_by_qual.setdefault(q, []).append(child)
+                    elif isinstance(child, ast.Import):
+                        for alias in child.names:
+                            import_map[alias.asname or alias.name.split(".")[0]] = (
+                                alias.name if alias.asname else alias.name.split(".")[0]
+                            )
+                    elif (
+                        isinstance(child, ast.ImportFrom)
+                        and child.module
+                        and child.level == 0
+                    ):
+                        for alias in child.names:
+                            import_map[alias.asname or alias.name] = (
+                                f"{child.module}.{alias.name}"
+                            )
+                    walk(child, prefix, fstack)
 
-        walk(self.tree, "")
+        walk(self.tree, "", ())
         self.qualname_of = {node: q for q, node in self.functions}
+        #: Call nodes whose first positional arg is a bare Name — the only
+        #: shape that can pass a local function into jit/shard_map/vmap.
+        self._name_arg_calls = name_arg_calls
+        #: function qualname -> keyword-bearing Call nodes anywhere under
+        #: that function (checkers index these instead of re-walking
+        #: every function body)
+        self.kw_calls_by_qual = kw_calls_by_qual
 
     def resolve(self, node: ast.AST) -> "str | None":
         """Resolve a call target to its fully-qualified origin where the
@@ -502,13 +527,15 @@ class FileContext:
                         dec.args and self._is_jit_ref(dec.args[0])
                     ):
                         self._mark(fn, self._static_names_from_kwargs(dec, fn), "decorator")
-        # functions passed by name into jit/shard_map/vmap calls
-        for node in ast.walk(self.tree):
-            if not (isinstance(node, ast.Call) and self._is_tracing_transform(node.func)):
+        # functions passed by name into jit/shard_map/vmap calls — the
+        # candidate Call nodes were gathered by _collect's single walk;
+        # check the (cheap) local-function lookup before resolving the
+        # callee so most call sites never hit the import map.
+        for node in self._name_arg_calls:
+            fns = self.functions_by_name.get(node.args[0].id)
+            if not fns or not self._is_tracing_transform(node.func):
                 continue
-            if not (node.args and isinstance(node.args[0], ast.Name)):
-                continue
-            for fn in self.functions_by_name.get(node.args[0].id, ()):
+            for fn in fns:
                 self._mark(fn, self._static_names_from_kwargs(node, fn), "call")
         # nested defs inside a jitted scope trace with it (lax.map/scan bodies)
         for fn in list(self.jit_scopes):

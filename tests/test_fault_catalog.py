@@ -1,0 +1,186 @@
+"""Fault-site drift gate (ISSUE 16 satellite), in the spirit of
+tests/test_metric_catalog.py: every ``faults.maybe_fail(<site>)``
+injection point in code must appear in the docs/robustness.md spec-
+grammar site list, and every site the doc names must exist in code —
+an operator arming a documented-but-renamed site would silently drill
+nothing.
+
+Detection is AST-based so the gate needs no imports and no fault
+registry state. Three call shapes are recognized:
+
+* ``faults.maybe_fail("broker.append")`` — literal site;
+* ``asyncio.to_thread(faults.maybe_fail, "serving.request")`` — the
+  callable passed by reference with the site as the following literal;
+* ``faults.maybe_fail(site)`` where ``site = f"{self.tier}.generation"``
+  in the same function — the dynamic per-tier site, expanded against
+  the tier literals the layer subclasses pass to ``super().__init__``
+  (so adding a new tier forces a doc update here).
+
+The doc side is a token scan with a ``(?<!oryx\\.)`` lookbehind so
+``oryx.faults``-style config keys (``oryx.batch.…``) don't count as
+site mentions."""
+
+import ast
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "robustness.md")
+PKG = os.path.join(REPO, "oryx_tpu")
+
+_DOC_SITE_RE = re.compile(
+    r"(?<!oryx\.)\b(?:broker|ckpt|serving|batch|speed)\.[a-z_]+"
+)
+
+
+def _iter_trees():
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, encoding="utf-8") as fh:
+                yield os.path.relpath(path, REPO), ast.parse(fh.read())
+
+
+def _is_maybe_fail(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "maybe_fail"
+    ) or (isinstance(node, ast.Name) and node.id == "maybe_fail")
+
+
+def _generation_fstring(node) -> bool:
+    """``f"{<expr>}.generation"`` — one hole, then the literal suffix."""
+    return (
+        isinstance(node, ast.JoinedStr)
+        and len(node.values) == 2
+        and isinstance(node.values[0], ast.FormattedValue)
+        and isinstance(node.values[1], ast.Constant)
+        and node.values[1].value == ".generation"
+    )
+
+
+def _tier_literals() -> set:
+    """Tier names layer subclasses pass to ``super().__init__``."""
+    out = set()
+    for rel, tree in _iter_trees():
+        if not rel.startswith("oryx_tpu/lambda_rt/"):
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "__init__"
+                and isinstance(node.func.value, ast.Call)
+                and isinstance(node.func.value.func, ast.Name)
+                and node.func.value.func.id == "super"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                out.add(node.args[1].value)
+    return out
+
+
+def _site_args(tree):
+    """Yield the AST node holding the site for each maybe_fail use."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_maybe_fail(node.func) and node.args:
+            yield tree, node, node.args[0]
+        else:
+            # callable passed by reference: the site is the next arg
+            for i, arg in enumerate(node.args):
+                if _is_maybe_fail(arg) and i + 1 < len(node.args):
+                    yield tree, node, node.args[i + 1]
+
+
+def _resolve_name_to_fstring(tree, call, name):
+    """``maybe_fail(site)``: find ``site = f"…"`` in an enclosing
+    function, innermost outward — the dynamic-site idiom in layer.py
+    assigns in ``_run_generation`` and fires inside a nested closure."""
+    enclosing = [
+        fn for fn in ast.walk(tree)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and fn.lineno <= call.lineno <= getattr(fn, "end_lineno", fn.lineno)
+    ]
+    for fn in sorted(enclosing, key=lambda f: f.lineno, reverse=True):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in node.targets
+                )
+                and _generation_fstring(node.value)
+            ):
+                return node.value
+    return None
+
+
+def _code_sites() -> dict:
+    """{site name: relpath of one injection point}."""
+    tiers = _tier_literals()
+    out: dict = {}
+    unresolved = []
+    for rel, tree in _iter_trees():
+        for tree_, call, arg in _site_args(tree):
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.setdefault(arg.value, rel)
+            elif _generation_fstring(arg):
+                for tier in tiers:
+                    out.setdefault(f"{tier}.generation", rel)
+            elif isinstance(arg, ast.Name):
+                fstr = _resolve_name_to_fstring(tree_, call, arg.id)
+                if fstr is not None:
+                    for tier in tiers:
+                        out.setdefault(f"{tier}.generation", rel)
+                else:
+                    unresolved.append(f"{rel}:{call.lineno}")
+            else:
+                unresolved.append(f"{rel}:{call.lineno}")
+    assert not unresolved, (
+        "maybe_fail called with a site this gate cannot resolve "
+        f"statically: {unresolved} — use a literal or the "
+        'f"{self.tier}.generation" idiom'
+    )
+    return out
+
+
+def _doc_sites() -> set:
+    with open(DOC, encoding="utf-8") as fh:
+        return set(_DOC_SITE_RE.findall(fh.read()))
+
+
+def test_tier_literals_found():
+    assert _tier_literals() == {"batch", "speed"}
+
+
+def test_every_code_site_is_documented():
+    code, doc = _code_sites(), _doc_sites()
+    missing = {s: rel for s, rel in code.items() if s not in doc}
+    assert not missing, (
+        f"fault sites injected in code but absent from {DOC}: {missing} "
+        "— add them to the robustness.md site list (spec grammar section)"
+    )
+
+
+def test_every_documented_site_exists_in_code():
+    code, doc = _code_sites(), _doc_sites()
+    stale = sorted(doc - set(code))
+    assert not stale, (
+        f"docs/robustness.md documents fault sites with no maybe_fail "
+        f"injection point in code: {stale} — a drill against these arms "
+        "nothing"
+    )
+
+
+def test_site_surface_is_nontrivial():
+    # the catalog had 11 sites when this gate landed; a scan that
+    # suddenly finds almost nothing is a broken gate, not a small repo
+    code = _code_sites()
+    assert len(code) >= 8, f"only found {sorted(code)}"
+    assert "broker.append" in code and "serving.request" in code
+    assert "batch.generation" in code and "speed.generation" in code

@@ -1,0 +1,481 @@
+"""Tier-1 tests for the protocol model checker (ISSUE 16).
+
+Four layers:
+
+* engine unit tests on toy models (sleep-set exploration, crash
+  budget, liveness drain, replay semantics, minimization);
+* the ISSUE 16 acceptance runs — every HEAD model explores clean and
+  COMPLETE to the tier-1 depth, and the explorer rediscovers all three
+  historical protocol bugs from their buggy-variant models;
+* the committed counterexample fixtures under
+  tests/data/protocol_schedules/ replay as a violation on their buggy
+  variant and as blocked/clean at HEAD, every tier-1 run;
+* the ``protocol-model-drift`` conformance checker: stale annotations
+  and unmodelled guard-relevant transport functions both fire on
+  fixtures, and the real package is clean at HEAD.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from oryx_tpu.tools.analyze import protocol as proto
+from oryx_tpu.tools.analyze.protocol.machine import (
+    Action,
+    Model,
+    S,
+    explore,
+    render_schedule,
+    replay,
+    shortest_counterexample,
+    tuple_set,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "data", "protocol_schedules")
+
+
+# ---------------------------------------------------------------------------
+# engine: toy models
+# ---------------------------------------------------------------------------
+
+
+def test_state_record_is_immutable_and_structural():
+    a = S(x=1, members=frozenset({"c0"}))
+    b = a.updated(x=2)
+    assert a.x == 1 and b.x == 2
+    assert a.members is b.members
+    assert a == S(members=frozenset({"c0"}), x=1)
+    assert hash(a) == hash(S(x=1, members=frozenset({"c0"})))
+    assert a != b
+    with pytest.raises(AttributeError):
+        a.missing
+
+
+def test_tuple_set():
+    assert tuple_set((1, 2, 3), 1, 9) == (1, 9, 3)
+    assert tuple_set((1,), 0, 0) == (0,)
+
+
+def _toy(invariant, *, bound=2, liveness=None):
+    """Two independent counters; invariant parameterized by the test."""
+
+    def inc(field):
+        def fire(s):
+            v = getattr(s, field)
+            return s.updated(**{field: v + 1}) if v < bound else None
+
+        return fire
+
+    return Model(
+        name="toy",
+        initial=S(x=0, y=0),
+        actions=(
+            Action("x.inc", inc("x"), vars=frozenset({"x"})),
+            Action("y.inc", inc("y"), vars=frozenset({"y"})),
+        ),
+        invariants=(("inv", invariant),),
+        liveness=liveness,
+    )
+
+
+def test_explore_clean_model_visits_every_state():
+    model = _toy(lambda s: None, bound=2)
+    res = explore(model, depth=10)
+    assert res.ok and res.complete
+    # sleep sets must not LOSE states: the reachable space is the 3x3 grid
+    assert res.states == 9
+    # ...but must prune interleavings: full DFS would take 2 transitions
+    # out of most states; the reduced run explores far fewer than the
+    # unreduced worst case while covering all states
+    assert res.transitions < 2 * res.states
+
+
+def test_explore_finds_and_minimizes_violation():
+    model = _toy(lambda s: "both" if s.x >= 1 and s.y >= 1 else None)
+    res = explore(model, depth=10)
+    assert not res.ok
+    v = res.violation
+    assert v.invariant == "inv" and v.minimized
+    assert len(v.schedule) == 2  # BFS minimization: one of each
+    assert sorted(v.schedule) == ["x.inc", "y.inc"]
+    # the rendered schedule is numbered and names the invariant
+    text = render_schedule(model, v)
+    assert "1. " in text and "invariant=inv" in text
+
+
+def test_crash_budget_bounds_crash_actions():
+    # a violation only reachable after 3 crashes must be invisible under
+    # a budget of 2, and found under 3
+    def crash(s):
+        return s.updated(n=s.n + 1)
+
+    model = Model(
+        name="crashy",
+        initial=S(n=0),
+        actions=(Action("crash", crash, vars=frozenset({"n"}), kind="crash",
+                        progress=False),),
+        invariants=(("three", lambda s: "3" if s.n >= 3 else None),),
+    )
+    assert explore(model, depth=10, crash_budget=2).ok
+    assert not explore(model, depth=10, crash_budget=3).ok
+
+
+def test_liveness_fires_when_progress_cannot_drain():
+    # a one-shot fault wedges the worker; at the resulting frontier the
+    # fair drain (progress actions only) cannot finish the work, so the
+    # bounded-liveness predicate fires with the path that got there
+    def fault(s):
+        return s.updated(stuck=True) if not s.stuck else None
+
+    def work(s):
+        return s.updated(y=s.y + 1) if (not s.stuck and s.y < 1) else None
+
+    model = Model(
+        name="stuck",
+        initial=S(y=0, stuck=False),
+        actions=(
+            Action("fault", fault, vars=frozenset({"stuck"}),
+                   kind="fault", progress=False),
+            Action("work", work, vars=frozenset({"y", "stuck"})),
+        ),
+        invariants=(),
+        liveness=("y-done", lambda s: None if s.y >= 1 else "y stuck"),
+    )
+    res = explore(model, depth=4)
+    assert not res.ok
+    assert res.violation.invariant == "y-done"
+    assert "fault" in res.violation.schedule
+
+
+def test_replay_statuses():
+    model = _toy(lambda s: "both" if s.x >= 1 and s.y >= 1 else None)
+    assert replay(model, ["x.inc", "y.inc"]).status == "violation"
+    assert replay(model, ["x.inc"]).status == "clean"
+    blocked = replay(model, ["x.inc", "x.inc", "x.inc"])
+    assert blocked.status == "blocked" and blocked.step == 3
+    with pytest.raises(KeyError):
+        replay(model, ["z.inc"])
+
+
+def test_shortest_counterexample_is_minimal():
+    model = _toy(lambda s: "deep" if s.x >= 2 else None, bound=3)
+    v = shortest_counterexample(model, invariant="inv", depth=10)
+    assert v is not None and list(v.schedule) == ["x.inc", "x.inc"]
+
+
+def test_canonicalize_collapses_symmetric_states():
+    # without canonicalization x grows forever; with it the epoch-like
+    # counter is rebased and the space is finite
+    def bump(s):
+        return s.updated(x=s.x + 1, y=s.y + 1)
+
+    model = Model(
+        name="sym",
+        initial=S(x=0, y=0),
+        actions=(Action("bump", bump, vars=frozenset({"x", "y"})),),
+        invariants=(),
+        canonicalize=lambda s: s.updated(x=0, y=s.y - s.x),
+    )
+    res = explore(model, depth=50)
+    assert res.ok and res.complete and res.states == 1
+
+
+# ---------------------------------------------------------------------------
+# the real models: ISSUE 16 acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_registry_surface():
+    assert set(proto.MODELS) == {
+        "consumer-group", "broker-append", "ckpt-generation",
+    }
+    for name in proto.MODELS:
+        model = proto.build_model(name)
+        assert model.variant == ""
+        assert model.sites(), f"{name} has no site annotations"
+        for variant in proto.MODEL_VARIANTS[name]:
+            assert proto.build_model(name, variant).variant == variant
+    with pytest.raises(ValueError):
+        proto.build_model("nope")
+    with pytest.raises(ValueError):
+        proto.build_model("broker-append", "nope")
+
+
+@pytest.mark.parametrize("name", ["broker-append", "ckpt-generation"])
+def test_head_model_explores_clean_fast(name):
+    res = explore(
+        proto.build_model(name),
+        depth=proto.TIER1_DEPTH,
+        crash_budget=proto.TIER1_CRASH_BUDGET,
+    )
+    assert res.ok, render_schedule(proto.build_model(name), res.violation)
+    assert res.complete
+
+
+def test_head_consumer_group_explores_clean_to_tier1_depth():
+    """The ISSUE 16 acceptance run: 3 consumers x 2 partitions with 2
+    crash/restarts, depth 12, clean and COMPLETE. This is the expensive
+    tier-1 test (~40 s); the time budget only guards against a runaway
+    regression — a truncated search fails the assertion."""
+    model = proto.build_model("consumer-group")
+    res = explore(
+        model,
+        depth=proto.TIER1_DEPTH,
+        crash_budget=proto.TIER1_CRASH_BUDGET,
+        time_budget=600.0,
+    )
+    assert res.ok, render_schedule(model, res.violation)
+    assert res.complete, (
+        f"exploration truncated at {res.states} states / {res.elapsed:.0f}s"
+    )
+    assert res.states > 10_000  # sanity: the space did not silently shrink
+
+
+@pytest.mark.parametrize("name,variant,invariant", proto.HISTORICAL_BUGS)
+def test_explorer_rediscovers_historical_bug(name, variant, invariant):
+    model = proto.build_model(name, variant)
+    res = explore(
+        model,
+        depth=proto.TIER1_DEPTH,
+        crash_budget=proto.TIER1_CRASH_BUDGET,
+    )
+    assert not res.ok, f"{model.key} should violate {invariant}"
+    v = res.violation
+    assert v.invariant == invariant
+    assert v.minimized and v.schedule
+    # the minimized schedule must itself replay to the violation
+    assert replay(model, list(v.schedule)).status == "violation"
+
+
+# ---------------------------------------------------------------------------
+# committed counterexample fixtures (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _fixtures():
+    paths = sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.json")))
+    assert paths, f"no schedule fixtures in {FIXTURE_DIR}"
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            yield os.path.basename(p), json.load(f)
+
+
+def test_fixtures_cover_all_historical_bugs():
+    covered = {
+        (fix["model"], fix["variant"], fix["invariant"])
+        for _, fix in _fixtures()
+    }
+    for bug in proto.HISTORICAL_BUGS:
+        assert bug in covered, f"no committed fixture for {bug}"
+
+
+@pytest.mark.parametrize("fname,fix", list(_fixtures()))
+def test_schedule_fixture_replays(fname, fix):
+    variant_model = proto.build_model(fix["model"], fix["variant"])
+    res = replay(variant_model, fix["schedule"])
+    assert res.status == fix["expect"], (
+        f"{fname}: expected {fix['expect']} on {variant_model.key}, "
+        f"got {res.status} at step {res.step} ({res.action})"
+    )
+    if res.violation is not None:
+        assert res.violation.invariant == fix["invariant"]
+    if fix.get("expect_at_head"):
+        head = proto.build_model(fix["model"])
+        head_res = replay(head, fix["schedule"])
+        assert head_res.status == fix["expect_at_head"], (
+            f"{fname}: the fixed guard no longer stops this schedule at "
+            f"HEAD — got {head_res.status} at step {head_res.step} "
+            f"({head_res.action})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# protocol-model-drift conformance checker
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.tools.analyze import analyze_source  # noqa: E402
+from oryx_tpu.tools.analyze.core import build_project  # noqa: E402
+from oryx_tpu.tools.analyze.checkers.protocolmodel import (  # noqa: E402
+    ProtocolModelDriftChecker,
+)
+from oryx_tpu.tools.analyze.protocol.machine import Site  # noqa: E402
+
+_MODEL_SRC = '''
+SITES = {
+    "append": Site("oryx_tpu/transport/x.py", "Broker.append", 3),
+}
+'''
+
+_IMPL_OK = '''
+class Broker:
+    def append(self, rec):
+        self.log.append(rec)
+        return len(self.log)
+
+    def set_offset(self, group, part, off):
+        self.offsets[(group, part)] = off
+'''
+
+
+def _drift(catalog, extra):
+    """Run only protocol-model-drift over fixture sources with an
+    injected site catalog; the fixture transport lives under the real
+    transport prefix so direction 2 scans it."""
+    old_cat = ProtocolModelDriftChecker._catalog_override
+    ProtocolModelDriftChecker._catalog_override = catalog
+    try:
+        findings = analyze_source(
+            "# anchor module\n" + _MODEL_SRC,
+            filename="model_fixture.py",
+            checkers=["protocol-model-drift"],
+            extra_sources=extra,
+        )
+    finally:
+        ProtocolModelDriftChecker._catalog_override = old_cat
+    return [f for f in findings if f.checker == "protocol-model-drift"]
+
+
+def test_drift_clean_when_annotation_and_coverage_match():
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/x.py", "Broker.append", 3)),
+        ("model_fixture.py", "commit",
+         Site("oryx_tpu/transport/x.py", "Broker.set_offset", 7)),
+    ]
+    out = _drift(catalog, {"oryx_tpu/transport/x.py": _IMPL_OK})
+    assert out == []
+
+
+def test_drift_flags_missing_function():
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/x.py", "Broker.gone", 3)),
+        ("model_fixture.py", "commit",
+         Site("oryx_tpu/transport/x.py", "Broker.set_offset", 7)),
+    ]
+    out = _drift(catalog, {"oryx_tpu/transport/x.py": _IMPL_OK})
+    assert any("no such function" in f.message for f in out)
+
+
+def test_drift_flags_line_outside_function():
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/x.py", "Broker.append", 99)),
+        ("model_fixture.py", "commit",
+         Site("oryx_tpu/transport/x.py", "Broker.set_offset", 7)),
+    ]
+    out = _drift(catalog, {"oryx_tpu/transport/x.py": _IMPL_OK})
+    assert any("re-anchor" in f.message for f in out)
+
+
+def test_drift_flags_missing_fragment():
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/x.py", "Broker.append", 3,
+              contains="token dedup")),
+        ("model_fixture.py", "commit",
+         Site("oryx_tpu/transport/x.py", "Broker.set_offset", 7)),
+    ]
+    out = _drift(catalog, {"oryx_tpu/transport/x.py": _IMPL_OK})
+    assert any("fragment is gone" in f.message for f in out)
+
+
+def test_drift_flags_unmodelled_guard_relevant_function():
+    # set_offset exists in the fixture transport but no catalog site
+    # covers it -> direction 2 fires on the uncovered function
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/x.py", "Broker.append", 3)),
+    ]
+    out = _drift(catalog, {"oryx_tpu/transport/x.py": _IMPL_OK})
+    flagged = [f for f in out if "guard-relevant" in f.message]
+    assert flagged and flagged[0].symbol == "Broker.set_offset"
+
+
+def test_drift_skips_out_of_scope_files():
+    # annotations into files not in the project are not findings
+    catalog = [
+        ("model_fixture.py", "append",
+         Site("oryx_tpu/transport/not_parsed.py", "Broker.append", 3)),
+    ]
+    assert _drift(catalog, {}) == []
+
+
+def test_drift_clean_at_head():
+    """The real models' annotations resolve against the real transport/
+    runtime files, and every guard-relevant transport function is
+    covered: zero findings over exactly the files the catalog names."""
+    targets = {site.path for _, _, site in
+               __import__("oryx_tpu.tools.analyze.checkers.protocolmodel",
+                          fromlist=["_site_catalog"])._site_catalog()}
+    paths = [os.path.join(REPO_ROOT, rel) for rel in sorted(targets)]
+    paths.append(os.path.join(REPO_ROOT, "oryx_tpu", "transport"))
+    project, errors = build_project(paths, REPO_ROOT)
+    assert not errors
+    out = ProtocolModelDriftChecker().check(project)
+    assert out == [], [f.render() for f in out]
+
+
+# ---------------------------------------------------------------------------
+# CLI: analyze --protocol
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.tools.analyze.cli import main as cli_main  # noqa: E402
+
+
+def test_cli_protocol_explores_fast_model(capsys):
+    rc = cli_main(["--protocol", "--model", "ckpt-generation"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "OK" in out
+
+
+def test_cli_protocol_variant_prints_counterexample(capsys):
+    rc = cli_main([
+        "--protocol", "--model", "broker-append",
+        "--variant", "no-token-dedup",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "VIOLATION no-duplicate-append" in out
+    assert "counterexample" in out and "prod.retry.s1" in out
+
+
+def test_cli_protocol_json(capsys):
+    rc = cli_main([
+        "--protocol", "--model", "broker-append", "--format", "json",
+    ])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["ok"]
+    (entry,) = data["protocol"]
+    assert entry["model"] == "broker-append" and entry["complete"]
+
+
+def test_cli_protocol_schedule_replay(capsys):
+    fixture = os.path.join(FIXTURE_DIR, "broker_no_token_dedup.json")
+    rc = cli_main(["--protocol", "--schedule", fixture])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "expected violation [ok]" in out
+    assert "expected blocked [ok]" in out
+
+
+def test_cli_protocol_flag_guards(capsys):
+    # findings-mode flags do not combine with --protocol
+    assert cli_main(["--protocol", "--cost"]) == 2
+    assert cli_main(["--protocol", "--changed"]) == 2
+    # --schedule fixes model/variant/depth itself
+    fixture = os.path.join(FIXTURE_DIR, "broker_no_token_dedup.json")
+    assert cli_main([
+        "--protocol", "--schedule", fixture, "--model", "broker-append",
+    ]) == 2
+    # protocol flags need --protocol
+    assert cli_main(["--depth", "4"]) == 2
+    assert cli_main(["--schedule", fixture]) == 2
+    # --variant without --model is ambiguous
+    assert cli_main(["--protocol", "--variant", "no-token-dedup"]) == 2
+    capsys.readouterr()
