@@ -45,6 +45,7 @@ BATCH_SUBPROC_TIMEOUT = 420  # ALS loops budget 210 s + gen/pack + compiles
 EXTRAS_SUBPROC_TIMEOUT = 360  # internal deadline 280 s + final section slack
 SERVING_SUBPROC_TIMEOUT = 420
 TRANSPORT_SUBPROC_TIMEOUT = 180  # 3 backends x (throughput + wakeup trials)
+LINEAGE_SUBPROC_TIMEOUT = 300  # tiny end-to-end lambda loop on CPU
 
 # the launch environment's platform setting, BEFORE any fallback mutates it —
 # probes and accelerator subprocesses must see this, not a sticky "cpu"
@@ -938,6 +939,119 @@ def _transport_bench(n_msgs: int = 2_000, n_wakeup_trials: int = 12,
     return out
 
 
+def _lineage_bench() -> dict:
+    """Measured time-to-model: wall time from appending input to the first
+    HTTP answer whose ``x-oryx-model-generation`` response header names a
+    generation whose ``/lineage`` provenance offsets PROVABLY cover that
+    input (docs/observability.md "Model lineage & freshness"). This is the
+    lambda architecture's headline latency — how stale is "eventually" —
+    measured end to end through the real BatchLayer + ServingLayer on a
+    tiny ALS dataset, not inferred from component numbers. Runs on CPU:
+    the quantity under test is pipeline plumbing, not device throughput."""
+    import tempfile
+    import threading  # noqa: F401 — parity with sibling sections
+
+    import httpx
+
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.common import ioutils
+    from oryx_tpu.lambda_rt.batch import BatchLayer
+    from oryx_tpu.serving.app import ServingLayer
+    from oryx_tpu.transport import topic as tp
+
+    tmp = tempfile.mkdtemp(prefix="oryx-lineage-bench-")
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "lineage-bench",
+            "oryx.batch.update-class":
+                "oryx_tpu.models.als.update.ALSUpdate",
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.serving.api.port": port,
+            "oryx.batch.storage.data-dir": os.path.join(tmp, "data"),
+            "oryx.batch.storage.model-dir": os.path.join(tmp, "model"),
+            "oryx.batch.streaming.config.platform": "cpu",
+            "oryx.als.iterations": 3,
+            "oryx.als.hyperparams.features": 6,
+            "oryx.ml.eval.test-fraction": 0.2,
+            "oryx.ml.eval.candidates": 1,
+        },
+        cfg.get_default(),
+    )
+    tp.reset_memory_brokers()
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    rng = np.random.default_rng(7)
+    scores = rng.standard_normal((30, 3)) @ rng.standard_normal((3, 20))
+    lines = [
+        f"u{u},i{i},1,{u * 1000 + int(i)}"
+        for u in range(30)
+        for i in np.argsort(-scores[u])[:6]
+    ]
+    serving = ServingLayer(config)
+    serving.start()
+    batch = BatchLayer(config)
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    broker = tp.get_broker("memory:")
+    try:
+        # start the layer FIRST (it resolves its start offset at the broker
+        # head, so earlier appends would be skipped), then start the clock
+        # at input append — generation interval, training, publish,
+        # consume, warm and promote all land inside the measurement
+        batch.start(interval_sec=0.5)
+        t0 = time.perf_counter()
+        for line in lines:
+            producer.send(None, line)
+        planted_size = broker.size("OryxInput")
+        gen = None
+        ttm = None
+        with httpx.Client(
+            base_url=f"http://127.0.0.1:{port}", timeout=30
+        ) as client:
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                r = client.get("/recommend/u0?howMany=2")
+                cand = r.headers.get("x-oryx-model-generation")
+                if r.status_code == 200 and cand:
+                    covered = False
+                    for g in client.get("/lineage").json()["generations"]:
+                        offsets = (g.get("stamp") or {}).get("offsets") or {}
+                        if (g["generation"] == cand
+                                and offsets.get("0", 0) >= planted_size):
+                            covered = True
+                    if covered:
+                        gen, ttm = cand, time.perf_counter() - t0
+                        break
+                time.sleep(0.1)
+            if ttm is None:
+                raise RuntimeError(
+                    "no attributable generation within the deadline"
+                )
+            lineage_doc = client.get("/lineage").json()
+    finally:
+        batch.close()
+        serving.close()
+        tp.reset_memory_brokers()
+    return {
+        "metric": "time_to_model",
+        "value": round(ttm, 2),
+        "unit": "s",
+        "generation": gen,
+        "input_rows": len(lines),
+        "adoption_lag_s": round(
+            lineage_doc.get("adoption_lag_seconds") or 0.0, 3
+        ),
+        "freshness_s": round(
+            lineage_doc.get("freshness_seconds") or 0.0, 3
+        ),
+        "note": "input append -> first HTTP answer whose response "
+                "generation's /lineage offsets cover the appended input; "
+                "real BatchLayer + ServingLayer, memory broker, CPU",
+    }
+
+
 def _section_subproc(argv: list, timeout: int, force_cpu: bool = False,
                      env: "dict | None" = None, *, metric: str) -> dict:
     """One bench section in its own subprocess with its own timeout: a hang
@@ -1027,6 +1141,15 @@ def main() -> None:
         metric="transport_microbench",
     )
 
+    # measured time-to-model: input append -> first attributable HTTP answer
+    # through the real batch + serving layers (the lambda architecture's
+    # bounded-staleness headline, rendered by trace_summary --history)
+    record["lineage"] = _section_subproc(
+        [os.path.join(here, "bench.py"), "--lineage"],
+        LINEAGE_SUBPROC_TIMEOUT, force_cpu=True,
+        metric="time_to_model",
+    )
+
     # the most recent on-chip evidence rides along with provenance, so a
     # tunnel flap during THIS run cannot erase the round's TPU record
     last = _load_last_tpu()
@@ -1056,6 +1179,18 @@ if __name__ == "__main__":
         except Exception as e:  # noqa: BLE001 — always emit a JSON line
             print(json.dumps({
                 "metric": "transport_microbench",
+                "error": f"{type(e).__name__}: {e}",
+            }))
+        sys.exit(0)
+    if "--lineage" in sys.argv:
+        try:
+            from oryx_tpu.common.executils import pin_cpu_platform_if_forced
+
+            pin_cpu_platform_if_forced()
+            print(json.dumps(_lineage_bench()))
+        except Exception as e:  # noqa: BLE001 — always emit a JSON line
+            print(json.dumps({
+                "metric": "time_to_model",
                 "error": f"{type(e).__name__}: {e}",
             }))
         sys.exit(0)

@@ -92,10 +92,11 @@ class AbstractServingModelManager(ServingModelManager):
     (AbstractServingModelManager.java:88)."""
 
     def consume(self, updates: Iterator[KeyMessage]) -> None:
-        from oryx_tpu.common import blackbox
+        from oryx_tpu.common import blackbox, lineage
 
         for km in updates:
-            if km.key in ("MODEL", "MODEL-REF"):
+            is_model = km.key in ("MODEL", "MODEL-REF")
+            if is_model:
                 # counted before dispatch so every app family (ALS, k-means,
                 # RDF, examples) reports generations uniformly
                 _MODEL_GENERATIONS.inc()
@@ -106,7 +107,25 @@ class AbstractServingModelManager(ServingModelManager):
                     message_bytes=len(km.message)
                     if isinstance(km.message, (str, bytes)) else None,
                 )
+                # adoption timeline opens at consume (headers carry the
+                # batch tier's provenance stamp when lineage is on)
+                lineage.tracker().model_consumed(km.key, km.headers)
+            elif km.headers:
+                # speed-tier fold-in deltas advance the freshness watermark
+                lineage.tracker().delta_consumed(km.headers)
             self.consume_key_message(km.key, km.message)
+            if is_model:
+                # in-place managers serve the new generation as soon as the
+                # dispatch returns; double-buffering managers hold it staged
+                # until the warmer (or the swap deadline) promotes it
+                try:
+                    staged = self.get_staged_model()
+                except Exception:  # noqa: BLE001 — tracker must never kill consume
+                    staged = None
+                if staged is None:
+                    lineage.tracker().mark_live()
+                else:
+                    lineage.tracker().mark_staged()
 
     @abc.abstractmethod
     def consume_key_message(self, key: str, message: str) -> None:

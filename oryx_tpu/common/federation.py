@@ -419,6 +419,20 @@ def replica_row(scrape: ReplicaScrape, prev: "ReplicaScrape | None" = None,
         row["warmup"] = f"{warm.get('done', 0)}/{warm.get('total', 0)}"
     else:
         row["warmup"] = "-"
+    fresh = _scalar_max(scrape, "oryx_model_data_freshness_seconds")
+    # the gauge reports -1 until a stamped generation goes live; the table
+    # shows "-" (unknown) rather than a misleading negative age
+    row["fresh_s"] = fresh if fresh is not None and fresh >= 0 else None
+    gen, gen_ts = None, None
+    for n, key, value in scrape.scalars:
+        if n != "oryx_model_generation_info" or value <= 0:
+            continue  # zeroed children are past generations
+        if gen_ts is None or value > gen_ts:
+            gen, gen_ts = dict(key).get("generation"), value
+    row["generation"] = gen
+    # publish unix-seconds of the live generation: orderable across
+    # replicas, so table_rows can flag the laggards (generation skew)
+    row["_gen_ts"] = gen_ts
     return row
 
 
@@ -473,9 +487,30 @@ def table_rows(snapshot: FleetSnapshot,
     fleet["breaker_open"] = max(
         (r.get("breaker_open") or 0.0 for r in up_rows), default=0.0)
     fleet["warmup"] = "-"
+    fresh_vals = [r["fresh_s"] for r in up_rows
+                  if r.get("fresh_s") is not None]
+    fleet["fresh_s"] = max(fresh_vals) if fresh_vals else None
+    # generation skew: a replica still serving an OLDER generation than the
+    # newest one adopted anywhere in the fleet gets flagged — that is the
+    # rollout laggard an operator wants to see at a glance
+    gen_ts_vals = [r["_gen_ts"] for r in up_rows
+                   if r.get("_gen_ts") is not None]
+    newest_ts = max(gen_ts_vals) if gen_ts_vals else None
+    newest = [r for r in up_rows if r.get("_gen_ts") == newest_ts]
+    fleet["generation"] = newest[0].get("generation") if newest else None
+    fleet["generation_skew"] = False
+    for r in up_rows:
+        r["generation_skew"] = (
+            newest_ts is not None
+            and r.get("_gen_ts") is not None
+            and r["_gen_ts"] < newest_ts
+        )
+        fleet["generation_skew"] = (
+            fleet["generation_skew"] or r["generation_skew"])
     for r in rows:  # internal window-delta scratch never leaves the API
         r.pop("_d_total", None)
         r.pop("_d_errors", None)
+        r.pop("_gen_ts", None)
     rows.append(fleet)
     return rows
 
@@ -492,7 +527,8 @@ def render_table(rows: list) -> str:
         f"{'replica':<24} {'up':>3} {'rdy':>3} {'warm':>7} {'reqs':>9} "
         f"{'qps':>8} {'err%':>6} {'p50ms':>8} {'p99ms':>8} {'shed':>6} "
         f"{'degr':>6} {'brk':>3} {'lag':>6} {'mfu%':>6} {'hbm_mb':>8} "
-        f"{'burn':>7} {'alrt':>4} {'budget':>6}"
+        f"{'burn':>7} {'alrt':>4} {'budget':>6} {'fresh_s':>8} "
+        f"{'generation':>15}"
     ]
     for r in rows:
         if not r.get("up"):
@@ -519,7 +555,11 @@ def render_table(rows: list) -> str:
             f"{_cell((r.get('hbm_bytes') or 0.0) / (1 << 20), '{:8.1f}', 8)} "
             f"{_cell(r.get('worst_burn_rate'), '{:7.2f}', 7)} "
             f"{_cell(r.get('slo_alerts'), '{:4d}', 4)} "
-            f"{_cell(r.get('budget_remaining'), '{:6.3f}', 6)}"
+            f"{_cell(r.get('budget_remaining'), '{:6.3f}', 6)} "
+            f"{_cell(r.get('fresh_s'), '{:8.1f}', 8)} "
+            # a trailing '*' flags generation skew: this replica serves an
+            # older generation than the fleet's newest
+            f"{(r.get('generation') or '-') + ('*' if r.get('generation_skew') else ''):>15}"
         )
     return "\n".join(out) + "\n"
 

@@ -441,6 +441,23 @@ oryx = {
       threshold-ms = 500
       window-sec = 86400
     }
+    freshness = {
+      # Off by default: a freshness objective only means something against
+      # a deployment's own batch cadence. When enabled, each engine
+      # evaluation samples the live model's data age (the lineage
+      # watermark, common/lineage.py) — good while at or under
+      # threshold-sec — and the burn-rate machinery alerts on sustained
+      # staleness: the lambda architecture's bounded-staleness contract
+      # as an SLO.
+      enabled = false
+      # Percent of freshness samples that must be at or under threshold-sec.
+      objective = 99.0
+      # Maximum acceptable age (seconds) of the data covered by the live
+      # model + consumed speed deltas; size it to a few batch generation
+      # intervals.
+      threshold-sec = 600
+      window-sec = 86400
+    }
     burn-rate = {
       # Page when BOTH the 5m and 1h burn rates exceed this (14.4 = the
       # whole 30-day budget in ~2 days; Google SRE workbook defaults).
@@ -448,6 +465,24 @@ oryx = {
       # Ticket when BOTH the 30m and 6h burn rates exceed this.
       slow-threshold = 6
     }
+  }
+
+  # Model lineage & data freshness (common/lineage.py, docs/observability.md
+  # "Model lineage & freshness"): provenance stamps on every published
+  # MODEL/update message (generation id, input offsets, watermark, train
+  # timing, checkpoint fingerprint, resume/scratch origin), watermark
+  # headers on speed-tier deltas, and the serving-side adoption tracker
+  # behind GET /lineage, oryx_model_data_freshness_seconds /
+  # oryx_model_adoption_lag_seconds / oryx_model_generation_info, and the
+  # x-oryx-model-generation response header.
+  lineage = {
+    # Master switch: off stops stamping outgoing publishes; the serving
+    # tracker still runs (consumed stamps are recorded either way) but the
+    # freshness gauges read -1 with nothing stamped upstream.
+    enabled = true
+    # Adoption records retained per replica behind GET /lineage (the live
+    # generation, the staged one, and their recent predecessors).
+    history = 8
   }
 
   # Metrics federation / fleet-status (common/federation.py, `python -m

@@ -72,7 +72,8 @@ _ALERT = metrics_mod.default_registry().gauge(
 #: operator surfaces whose request rate is scrape cadence, not user
 #: traffic (suffix match so context-path prefixes stay excluded too).
 OPS_ROUTE_SUFFIXES = (
-    "/metrics", "/trace", "/healthz", "/readyz", "/ready", "/error",
+    "/metrics", "/trace", "/lineage", "/healthz", "/readyz", "/ready",
+    "/error",
 )
 OPS_ROUTE_PARTS = ("/debug/",)
 
@@ -199,6 +200,28 @@ def _latency_reader(registry, threshold_ms: float):
             else:
                 good += sum(counts[: edge_i + 1])
         return good, total
+
+    return read
+
+
+def _freshness_reader(threshold_sec: float):
+    """Cumulative (good, total) over the model-data-freshness watermark
+    (common/lineage.py): each engine evaluation samples the live model's
+    data age once — good when it is at or under ``threshold-sec``. No
+    sample is taken while no watermark is known (a replica that never
+    adopted a stamped generation is unknown, not stale), so the objective
+    stays silent until lineage is actually flowing."""
+    from oryx_tpu.common import lineage
+
+    state = {"good": 0.0, "total": 0.0}
+
+    def read() -> tuple:
+        freshness = lineage.freshness_seconds()
+        if freshness is not None:
+            state["total"] += 1.0
+            if freshness <= threshold_sec:
+                state["good"] += 1.0
+        return state["good"], state["total"]
 
     return read
 
@@ -461,6 +484,17 @@ def configure(config) -> "SloEngine | None":
                 lat.get_float("objective", 99.0),
                 lat.get_float("window-sec", 86400.0),
                 _latency_reader(registry, lat.get_float("threshold-ms", 500.0)),
+            ))
+        fresh = config.get_config("oryx.slo.freshness")
+        if fresh.get_bool("enabled", False):
+            # data-freshness objective: burn-rate alerting when the live
+            # model's input-data age exceeds threshold-sec — the bounded-
+            # staleness contract of the lambda architecture as an SLO
+            objectives.append(Objective(
+                "freshness",
+                fresh.get_float("objective", 99.0),
+                fresh.get_float("window-sec", 86400.0),
+                _freshness_reader(fresh.get_float("threshold-sec", 600.0)),
             ))
         if not objectives:
             _ENGINE = None

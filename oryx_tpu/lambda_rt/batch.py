@@ -76,6 +76,10 @@ class BatchLayer(AbstractLayer):
             # folds them in, so a restarted generation — same uncommitted
             # offsets, same slice — resumes its own state and nothing else)
             context.input_offsets = self.current_input_offsets
+            # freshness identity for the published model's provenance stamp
+            # (lineage.make_stamp reads these off the context)
+            context.input_watermark_ms = self.current_input_watermark_ms
+            context.input_max_event_ms = self.current_input_max_event_ms
             producer = TopicProducerImpl(self.update_broker, self.update_topic)
             try:
                 self._update_instance.run_update(

@@ -124,6 +124,15 @@ class AbstractLayer:
         # are only committed after a generation completes, so a re-run
         # generation reads the same slice and lands on the same values.
         self.current_input_offsets: "dict[int, int] | None" = None
+        # freshness watermark: the wall time the current generation's input
+        # poll STARTED — every event appended before it is in the slice
+        # (each partition reads to its size() at poll time), so "data
+        # through T is incorporated" holds exactly. Cumulative like the
+        # offsets: it covers everything consumed so far, not one slice.
+        self.current_input_watermark_ms: "int | None" = None
+        # upper bound on the newest consumed event's arrival wall time
+        # (poll-start of the last non-empty slice)
+        self.current_input_max_event_ms: "int | None" = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._failure: BaseException | None = None
@@ -224,6 +233,7 @@ class AbstractLayer:
             # in place would silently skip the already-read messages on
             # the re-poll (batch dropped, offsets kept)
             new_offsets = dict(offsets)
+            poll_start_ms = int(time.time() * 1000)
             try:
                 for p in range(broker.num_partitions(self.input_topic)):
                     offset = new_offsets.get(p, 0)
@@ -253,6 +263,11 @@ class AbstractLayer:
                 continue
             offsets = new_offsets
             self.current_input_offsets = dict(offsets)
+            self.current_input_watermark_ms = poll_start_ms
+            if batch:
+                # newest-event upper bound: the newest consumed event landed
+                # between the previous poll and this one
+                self.current_input_max_event_ms = poll_start_ms
             if n_corrupt:
                 # one rate-limited (per-generation) line, not one per record:
                 # a corrupted log segment would otherwise flood the logger

@@ -14,10 +14,12 @@ SpeedLayerUpdate.java:51-63). Two concurrent activities:
 
 from __future__ import annotations
 
+import json
 from typing import Sequence
 
 from oryx_tpu.api.keymessage import KeyMessage
 from oryx_tpu.api.speed import SpeedModelManager
+from oryx_tpu.common import lineage
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import spans
 from oryx_tpu.lambda_rt.layer import AbstractLayer
@@ -72,8 +74,18 @@ class SpeedLayer(AbstractLayer):
         if not new_data:
             return
         updates = self.model_manager.build_updates(new_data)
+        # fold-in provenance: each delta carries the input offsets/watermark
+        # it incorporated, so the serving-side freshness watermark advances
+        # BETWEEN batch generations (lineage.delta_consumed reads this)
+        headers = None
+        if self.config.get_bool("oryx.lineage.enabled", True):
+            headers = {lineage.WATERMARK_HEADER: json.dumps({
+                "offsets": {str(p): int(o) for p, o in
+                            (self.current_input_offsets or {}).items()},
+                "watermark_ms": self.current_input_watermark_ms,
+            }, separators=(",", ":"))}
         for update in updates:
-            self._producer.send("UP", update)
+            self._producer.send("UP", update, headers=headers)
             _UPDATES_PUBLISHED.inc()
 
     def close(self) -> None:

@@ -18,12 +18,13 @@ import logging
 import math
 import shutil
 import tempfile
+import time
 from pathlib import Path
 from typing import Sequence
 
 from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.api.keymessage import KeyMessage
-from oryx_tpu.common import executils, rand
+from oryx_tpu.common import executils, lineage, rand
 from oryx_tpu.ml import param as hp
 from oryx_tpu.pmml import pmmlutils
 from oryx_tpu.store.datastore import ModelStore
@@ -107,6 +108,7 @@ class MLUpdate(BatchLayerUpdate):
 
     # -- BatchLayerUpdate (runUpdate:163-248) --------------------------------
     def run_update(self, context, timestamp_ms, new_data, past_data, model_dir, producer):
+        train_start_ms = int(time.time() * 1000)
         new_data = list(new_data)
         past_data = list(past_data)
         if not new_data and not past_data:
@@ -148,6 +150,18 @@ class MLUpdate(BatchLayerUpdate):
         pmml = pmmlutils.read(model_file)
         pmml_string = pmmlutils.to_string(pmml)
         if producer is not None:
+            # provenance stamp on the publish: generation id (stable from
+            # the checkpoint fingerprint when there is one), the input
+            # offsets/watermark the batch layer recorded on the context,
+            # train timing, origin, row counts — every send below carries it
+            if self.config.get_bool("oryx.lineage.enabled", True):
+                stamp = lineage.make_stamp(
+                    context, timestamp_ms,
+                    train_start_ms=train_start_ms,
+                    train_end_ms=int(time.time() * 1000),
+                    new_rows=len(new_data), past_rows=len(past_data),
+                )
+                producer = lineage.StampedProducer(producer, stamp)
             # inline if small enough, else by reference (MLUpdate.java:219-233)
             if len(pmml_string) <= self.max_message_size:
                 producer.send("MODEL", pmml_string)

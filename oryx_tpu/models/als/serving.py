@@ -33,6 +33,7 @@ from oryx_tpu.api.serving import ServingModel
 from oryx_tpu.ml.mlupdate import read_pmml_from_update_key_message
 from oryx_tpu.api.serving import AbstractServingModelManager
 from oryx_tpu.common import compilecache
+from oryx_tpu.common import lineage
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import spans
@@ -1457,6 +1458,10 @@ class ALSServingModelManager(AbstractServingModelManager):
             self.model = staged
             self._staged = None
         (_DEADLINE_SWAPS if deadline else _PREWARMED_SWAPS).inc()
+        # adoption timeline: the staged generation just went into service
+        # (idempotent on the tracker side — the warmer and the deadline
+        # valve can both report the same flip)
+        lineage.tracker().mark_live()
         return True
 
     def _current_generation(self) -> "ALSServingModel | None":

@@ -134,6 +134,21 @@ class ALSUpdate(MLUpdate):
         finally:
             if cache is not None:
                 self._layout_cache_lock.release()
+        # lineage identity for the generation's provenance stamp: the
+        # checkpoint fingerprint keeps the generation id stable across a
+        # crash-restart (same uncommitted offsets → same fp), and origin
+        # records whether this training resumed or started from scratch.
+        # Parallel candidates race last-writer-wins; exact for candidates=1.
+        # Direct/test callers pass context=None — nothing to stamp onto.
+        if context is not None:
+            context.lineage_fingerprint = (
+                fp if checkpointer is not None else None
+            )
+            context.lineage_origin = (
+                "resume"
+                if checkpointer is not None and checkpointer.resumed_step
+                else "scratch"
+            )
         log.info(
             "ALS train: %d nnz, pack %.2fs on the critical path (user %.2fs"
             " + item wait %.2fs; modes %s)",

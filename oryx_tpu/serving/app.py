@@ -34,6 +34,7 @@ from oryx_tpu.common import classutils
 from oryx_tpu.common import compilecache
 from oryx_tpu.common import faults
 from oryx_tpu.common import ioutils
+from oryx_tpu.common import lineage
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
@@ -77,7 +78,9 @@ _UPDATE_LAG_MESSAGES = metrics_mod.default_registry().gauge(
 )
 _UPDATE_LAG_SECONDS = metrics_mod.default_registry().gauge(
     "oryx_serving_update_lag_seconds",
-    "Seconds since the serving layer last consumed an update message",
+    "Seconds since the update consumer last made progress; while idle on "
+    "an empty topic it reports the lineage watermark's data age instead "
+    "(0 when no watermark is known)",
 )
 _CONSUMER_RESTARTS = metrics_mod.default_registry().counter(
     "oryx_serving_consumer_restarts_total",
@@ -96,6 +99,19 @@ def _route_template(request: web.Request) -> str:
     path, which would mint one label set per user/item id)."""
     resource = getattr(request.match_info.route, "resource", None)
     return getattr(resource, "canonical", None) or "unmatched"
+
+
+def _attach_generation(response, route: str) -> None:
+    """Stamp ``x-oryx-model-generation`` on every model-backed response
+    (all four app families flow through this middleware), so any served
+    answer is attributable to a model generation after the fact. Probe and
+    ops routes are exempt — a /readyz poll is not a model query, and must
+    not count as one in the adoption timeline."""
+    if slo.is_ops_route(route):
+        return
+    gen = lineage.tracker().note_query()
+    if gen and "x-oryx-model-generation" not in response.headers:
+        response.headers["x-oryx-model-generation"] = gen
 
 
 @web.middleware
@@ -131,7 +147,9 @@ async def _metrics_middleware(request, handler):
         return await handler(request)
 
     if not record and not tracing:
-        return await _handle()
+        response = await _handle()
+        _attach_generation(response, route)
+        return response
     if record:
         _IN_FLIGHT.inc()
     t0 = time.perf_counter()
@@ -152,6 +170,7 @@ async def _metrics_middleware(request, handler):
             if trace_id:
                 response.headers[spans.TRACEPARENT] = sp.context.to_traceparent()
                 response.headers["x-oryx-trace-id"] = trace_id
+            _attach_generation(response, route)
             return response
     except web.HTTPException as e:
         status = e.status
@@ -160,6 +179,7 @@ async def _metrics_middleware(request, handler):
             # by id — the 404/401/4xx must carry the trace like any 200
             e.headers[spans.TRACEPARENT] = sp.context.to_traceparent()
             e.headers["x-oryx-trace-id"] = trace_id
+        _attach_generation(e, route)
         raise
     except asyncio.CancelledError:
         # client disconnect/timeout cancels the handler task: no response
@@ -186,9 +206,16 @@ def _lag_seconds_fn(metered_ref):
         if metered is None:
             return 0.0
         if metered._waiting:
-            # blocked in the broker pop = healthy and idle, not lagging —
-            # hours of quiet topic must never read as consumer staleness
-            return 0.0
+            # blocked in the broker pop = healthy and idle, not WEDGED — but
+            # "0 forever" also hid a stalled batch tier. With a provenance
+            # watermark known, idle reports the age of the data actually
+            # serving (the speed tier's stamped deltas keep it advancing
+            # between batch generations); without one (no stamped model
+            # yet), quiet stays 0 as before. /readyz is unaffected either
+            # way: stale additionally requires messages waiting behind the
+            # head, and an idle consumer has none.
+            freshness = lineage.freshness_seconds()
+            return freshness if freshness is not None else 0.0
         return max(0.0, time.time() - metered._last_walltime)
 
     return fn
@@ -350,6 +377,10 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     # active-alert list) — both per-process, like the metrics registry
     blackbox.configure(config)
     slo.configure(config)
+    # model-lineage tracker (adoption timeline + freshness watermark behind
+    # GET /lineage, the freshness gauges and the x-oryx-model-generation
+    # response header)
+    lineage.configure(config)
     netbroker.configure(config)  # tcp:// client timeouts/frame caps
     tp.configure(config)  # file-broker fsync durability policy
     # factor-arena sizing (oryx.serving.arena.*): new vector stores built by
@@ -435,11 +466,12 @@ def _exempt_canonicals(config) -> frozenset:
 
     ``/healthz``/``/readyz`` are ALWAYS exempt (load balancers cannot speak
     digest, and the probes leak nothing beyond up/down); ``/metrics``,
-    ``/trace``, ``/debug/profile``, and ``/debug/bundle`` share one auth
-    story — exempt unless ``oryx.metrics.require-auth``."""
+    ``/trace``, ``/lineage``, ``/debug/profile``, and ``/debug/bundle``
+    share one auth story — exempt unless ``oryx.metrics.require-auth``."""
     templates = {"/healthz", "/readyz"}
     if not config.get_bool("oryx.metrics.require-auth", False):
-        templates |= {"/metrics", "/trace", "/debug/profile", "/debug/bundle"}
+        templates |= {"/metrics", "/trace", "/lineage", "/debug/profile",
+                      "/debug/bundle"}
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
     prefix = context_path.rstrip("/")
     return frozenset(templates | {prefix + t for t in templates})
@@ -678,6 +710,9 @@ class _BatchWarmer(threading.Thread):
                 last_warmed = weakref.ref(model)
                 self.warmed_models += 1
                 failures = 0
+                # adoption timeline: ladder complete for the newest consumed
+                # generation (promote below flips it live)
+                lineage.tracker().mark_warmed()
                 # expected= guards the flip: a newer MODEL push may have
                 # replaced the staged generation while this ladder ran, and
                 # that replacement is unwarmed — leave it for the next pass

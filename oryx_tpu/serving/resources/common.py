@@ -24,6 +24,7 @@ from aiohttp import web
 from oryx_tpu.api.serving import OryxServingException
 from oryx_tpu.common import blackbox
 from oryx_tpu.common import compilecache
+from oryx_tpu.common import lineage
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import slo as slo_mod
@@ -164,6 +165,22 @@ async def trace(request: web.Request) -> web.Response:
     })
 
 
+async def lineage_view(request: web.Request) -> web.Response:
+    """Model lineage console (docs/observability.md "Model lineage &
+    freshness"): the provenance chain of the live and staged generations —
+    generation id, checkpoint fingerprint, resume/scratch origin, the
+    per-partition input offsets each generation trained through, its
+    publish→consume→warm→live→first-query adoption timeline — plus the
+    speed-tier delta watermark and the derived freshness numbers. This is
+    the attributability loop closer: take ``x-oryx-model-generation`` off
+    any response, look its offsets up here, and you know exactly which
+    input data produced that answer. Auth story = /metrics (exempt unless
+    ``oryx.metrics.require-auth``)."""
+    snapshot = await asyncio.to_thread(lineage.tracker().snapshot)
+    snapshot["enabled"] = lineage.enabled()
+    return web.json_response(snapshot)
+
+
 async def debug_profile(request: web.Request) -> web.Response:
     """On-demand device profiling of the live process:
     ``POST /debug/profile?seconds=N`` captures a ``jax.profiler`` trace for
@@ -236,5 +253,6 @@ def register(app: web.Application) -> None:
     app.router.add_route("GET", "/error", error)
     app.router.add_route("GET", "/metrics", metrics)
     app.router.add_route("GET", "/trace", trace)
+    app.router.add_route("GET", "/lineage", lineage_view)
     app.router.add_route("POST", "/debug/profile", debug_profile)
     app.router.add_route("GET", "/debug/bundle", debug_bundle)

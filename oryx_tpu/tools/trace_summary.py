@@ -640,6 +640,11 @@ def _history_row(label: str, rec: dict) -> dict:
         "slo_alerts": (int(slo["alerts_active"])
                        if isinstance(slo.get("alerts_active"), (int, float))
                        else None),
+        # round-17 lineage section: measured time-to-model (input append ->
+        # first attributable HTTP answer). NOT in _HISTORY_SERIES: older
+        # BENCH rounds have no cell, and a None cell never compares — the
+        # standing gate stays green across the column's introduction.
+        "ttm_s": _num((rec.get("lineage") or {}).get("value")),
     }
 
 
@@ -661,7 +666,8 @@ def render_history(records: list, regress_pct: float = 25.0,
     w(f"{'round':>6s} {'backend':>8s} {'qps':>10s} {'http_qps':>9s} "
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
       f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s} {'ckpt_ov':>7s} "
-      f"{'resume_sv':>9s} {'burn':>6s} {'budget':>6s} {'alrt':>4s}\n")
+      f"{'resume_sv':>9s} {'burn':>6s} {'budget':>6s} {'alrt':>4s} "
+      f"{'ttm_s':>7s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -681,7 +687,8 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['resume_saved_s'], '{:8.1f}s', 9)} "
           f"{cell(r['slo_burn'], '{:6.2f}', 6)} "
           f"{cell(r['slo_budget'], '{:6.3f}', 6)} "
-          f"{cell(r['slo_alerts'], '{:4d}', 4)}\n")
+          f"{cell(r['slo_alerts'], '{:4d}', 4)} "
+          f"{cell(r['ttm_s'], '{:6.1f}s', 7)}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]

@@ -164,3 +164,53 @@ def test_normalize_url():
     assert fed.normalize_url("host:8080") == "http://host:8080"
     assert fed.normalize_url("http://host:8080/") == "http://host:8080"
     assert fed.normalize_url("https://h/api/") == "https://h/api"
+
+
+def test_freshness_and_generation_columns_with_skew_marker():
+    """Round-17 lineage columns: per-replica freshness + live generation in
+    the operator table, with the fleet's newest adopted generation on the
+    FLEET row and a skew flag on every replica still serving an older one
+    (docs/observability.md "Model lineage & freshness")."""
+    lineage_a = (
+        "# TYPE oryx_model_data_freshness_seconds gauge\n"
+        "oryx_model_data_freshness_seconds 12.5\n"
+        "# TYPE oryx_model_generation_info gauge\n"
+        'oryx_model_generation_info{fingerprint="f1",generation="gaaa"} 1000\n'
+        'oryx_model_generation_info{fingerprint="f0",generation="gold"} 0\n'
+    )
+    lineage_b = (
+        "# TYPE oryx_model_data_freshness_seconds gauge\n"
+        "oryx_model_data_freshness_seconds 90.0\n"
+        "# TYPE oryx_model_generation_info gauge\n"
+        'oryx_model_generation_info{fingerprint="f1",generation="gbbb"} 2000\n'
+    )
+    r1 = _scrape_from_text("http://a:1", T_BASE + lineage_a)
+    r2 = _scrape_from_text("http://b:2", T_BASE + lineage_b)
+    rows = fed.table_rows(fed.FleetSnapshot([r1, r2]))
+    a = next(r for r in rows if r["replica"] == "a:1")
+    b = next(r for r in rows if r["replica"] == "b:2")
+    fleet = rows[-1]
+    assert a["fresh_s"] == 12.5 and b["fresh_s"] == 90.0
+    # zeroed children are PAST generations: gaaa (1000) wins on a, not gold
+    assert a["generation"] == "gaaa" and b["generation"] == "gbbb"
+    # b adopted the newest publish (2000): a is the rollout laggard
+    assert fleet["generation"] == "gbbb"
+    assert a["generation_skew"] is True and b["generation_skew"] is False
+    assert fleet["generation_skew"] is True
+    assert fleet["fresh_s"] == 90.0  # worst staleness fleet-wide
+    # scratch keys never leak, and the table renders the marker
+    assert not any(k == "_gen_ts" for r in rows for k in r)
+    text = fed.render_table(rows)
+    assert "gaaa*" in text and "gbbb" in text and "fresh_s" in text
+
+
+def test_replica_without_lineage_gauges_has_blank_columns():
+    # pre-round-17 replica (mid-rollout): no lineage gauges at all — the
+    # columns render "-" and the replica is never flagged as skewed
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    rows = fed.table_rows(fed.FleetSnapshot([r1]))
+    assert rows[0]["fresh_s"] is None
+    assert rows[0]["generation"] is None
+    assert rows[0]["generation_skew"] is False
+    assert rows[-1]["generation"] is None
+    fed.render_table(rows)  # renders without raising

@@ -1,0 +1,266 @@
+"""Model lineage & freshness (docs/observability.md "Model lineage &
+freshness"): provenance stamps round-trip every broker transport, the
+generation id is stable across a crash-restart exactly when the checkpoint
+fingerprint says the work is the same, the batch publish path stamps what
+the batch layer recorded, the speed tier's fold-in deltas advance the
+serving watermark, and the serving-side tracker derives the adoption
+timeline + freshness numbers the gauges and ``GET /lineage`` expose."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.api.keymessage import KeyMessage
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import lineage
+from oryx_tpu.transport import topic as tp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_brokers():
+    tp.reset_memory_brokers()
+    yield
+    tp.reset_memory_brokers()
+    tp.reset_tcp_clients()
+
+
+def _stamp(offsets=None, watermark_ms=None, fingerprint=None):
+    class _Ctx:
+        pass
+
+    ctx = _Ctx()
+    now_ms = int(time.time() * 1000)
+    ctx.input_offsets = offsets if offsets is not None else {0: 7}
+    ctx.input_watermark_ms = (watermark_ms if watermark_ms is not None
+                              else now_ms - 1_000)
+    ctx.lineage_fingerprint = fingerprint
+    return lineage.make_stamp(ctx, now_ms, train_start_ms=now_ms - 500,
+                              train_end_ms=now_ms, new_rows=7, past_rows=0)
+
+
+def test_mint_generation_id_fingerprint_stable_scratch_fresh():
+    # the crash-restart contract in one line: same fingerprint, same id
+    assert (lineage.mint_generation_id("abcdef0123456789")
+            == lineage.mint_generation_id("abcdef0123456789")
+            == "gabcdef012345")
+    # no fingerprint (checkpointing off): every mint is a fresh identity,
+    # even at the same millisecond
+    ts = int(time.time() * 1000)
+    assert (lineage.mint_generation_id(None, ts)
+            != lineage.mint_generation_id(None, ts))
+
+
+@pytest.mark.parametrize("scheme", ["memory", "file", "tcp"])
+def test_provenance_headers_round_trip_every_broker(scheme, tmp_path):
+    """The stamp rides KeyMessage headers, so it must survive each broker's
+    own wire format: in-process dicts (memory:), the JSONL durable log
+    (file:), and the netbroker RPC frame (tcp:)."""
+    server = None
+    if scheme == "memory":
+        url = "memory:lineage-rt"
+    elif scheme == "file":
+        url = f"file:{tmp_path}/topics"
+    else:
+        from oryx_tpu.transport import netbroker
+
+        server = netbroker.NetBrokerServer(
+            str(tmp_path / "broker"), host="127.0.0.1", port=0,
+        ).start_background()
+        url = f"tcp://127.0.0.1:{server.port}"
+    try:
+        broker = tp.get_broker(url)
+        broker.create_topic("OryxUpdate")
+        stamp = _stamp(offsets={0: 42}, fingerprint="feedbeefcafe0123")
+        producer = lineage.StampedProducer(
+            tp.TopicProducerImpl(url, "OryxUpdate"), stamp,
+        )
+        producer.send("MODEL", "fake-pmml")
+        producer.send("UP", '["Y","i0",[0.0]]')
+        msgs = broker.read("OryxUpdate", 0, 10)
+        assert [km.key for km in msgs] == ["MODEL", "UP"]
+        model_km, up_km = msgs
+        back = lineage.parse_stamp(model_km.headers)
+        assert back == stamp, f"stamp did not survive {scheme}"
+        assert back["offsets"] == {"0": 42}
+        assert (model_km.headers[lineage.GENERATION_HEADER]
+                == stamp["generation"] == "gfeedbeefcafe")
+        # factor-row UPs stay cheap: the bare generation id, no full stamp
+        assert (up_km.headers[lineage.GENERATION_HEADER]
+                == stamp["generation"])
+        assert lineage.parse_stamp(up_km.headers) is None
+    finally:
+        if server is not None:
+            tp.reset_tcp_clients()
+            server.close()
+
+
+class _RecordingProducer:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, key, message, headers=None):
+        self.sent.append((key, message, headers))
+
+
+def _als_lines(n_users=25, n_items=15, rank=3, per_user=5):
+    rng = np.random.default_rng(3)
+    scores = (rng.standard_normal((n_users, rank))
+              @ rng.standard_normal((rank, n_items)))
+    return [
+        f"u{u},i{i},1,{u * 100 + int(i)}"
+        for u in range(n_users)
+        for i in np.argsort(-scores[u])[:per_user]
+    ]
+
+
+def _als_config(tmp_path, checkpoint: bool):
+    overlay = {
+        "oryx.als.iterations": 2,
+        "oryx.als.hyperparams.features": 4,
+        "oryx.ml.eval.test-fraction": 0.0,
+        "oryx.ml.eval.candidates": 1,
+    }
+    if checkpoint:
+        overlay.update({
+            "oryx.batch.checkpoint.enabled": True,
+            "oryx.batch.checkpoint.dir": str(tmp_path / "ckpt"),
+            "oryx.batch.checkpoint.interval-iterations": 1,
+        })
+    return cfg.overlay_on(overlay, cfg.get_default())
+
+
+def _run_als_once(config, tmp_path, lines, offsets):
+    from oryx_tpu.models.als.update import ALSUpdate
+
+    class _Ctx:
+        pass
+
+    ctx = _Ctx()
+    ctx.input_offsets = dict(offsets)
+    ctx.input_watermark_ms = int(time.time() * 1000)
+    producer = _RecordingProducer()
+    ALSUpdate(config).run_update(
+        ctx, int(time.time() * 1000),
+        [KeyMessage(None, ln) for ln in lines], [],
+        str(tmp_path / "model"), producer,
+    )
+    model_sends = [s for s in producer.sent if s[0] in ("MODEL", "MODEL-REF")]
+    assert len(model_sends) == 1, [s[0] for s in producer.sent]
+    return lineage.parse_stamp(model_sends[0][2])
+
+
+def test_crash_restart_keeps_generation_id_with_checkpointing(tmp_path):
+    """A killed batch layer re-runs the generation over the SAME
+    uncommitted input slice: with checkpointing on, the recomputed data
+    fingerprint resumes the checkpoint AND republishes under the same
+    generation id — downstream consumers see one identity, not a phantom
+    second model."""
+    lines = _als_lines()
+    config = _als_config(tmp_path, checkpoint=True)
+    first = _run_als_once(config, tmp_path, lines, {0: len(lines)})
+    assert first is not None and first["origin"] == "scratch"
+    assert first["fingerprint"], "checkpointing on must stamp a fingerprint"
+    assert first["generation"] == "g" + first["fingerprint"][:12]
+    # simulated crash-restart: a FRESH update instance, same input slice
+    second = _run_als_once(config, tmp_path, lines, {0: len(lines)})
+    assert second["generation"] == first["generation"]
+    assert second["fingerprint"] == first["fingerprint"]
+    assert second["origin"] == "resume"
+    # the stamp carries the offsets the generation trained through
+    assert second["offsets"] == {"0": len(lines)}
+
+
+def test_scratch_generations_mint_fresh_ids_without_checkpointing(tmp_path):
+    lines = _als_lines()
+    config = _als_config(tmp_path, checkpoint=False)
+    first = _run_als_once(config, tmp_path, lines, {0: len(lines)})
+    second = _run_als_once(config, tmp_path, lines, {0: len(lines)})
+    assert first["origin"] == second["origin"] == "scratch"
+    assert first["fingerprint"] is None
+    assert first["generation"] != second["generation"]
+
+
+def test_speed_deltas_carry_watermark_header(tmp_path):
+    """The speed tier stamps each fold-in delta with the offsets/watermark
+    it incorporated — what keeps the serving freshness watermark advancing
+    BETWEEN batch generations."""
+    from oryx_tpu.lambda_rt.speed import SpeedLayer
+
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "lineage-speed",
+            "oryx.speed.model-manager-class":
+                "tests.test_lambda.MockSpeedManager",
+            "oryx.speed.streaming.config.platform": "cpu",
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    broker = tp.get_broker("memory:")
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    before_ms = int(time.time() * 1000)
+    speed = SpeedLayer(config)
+    speed.start(interval_sec=0.1)
+    try:
+        producer.send(None, "a,1")
+        deadline = time.monotonic() + 15
+        up = None
+        while time.monotonic() < deadline and up is None:
+            for km in broker.read("OryxUpdate", 0, 100):
+                if km.key == "UP":
+                    up = km
+            time.sleep(0.05)
+        assert up is not None, "speed tier produced no UP"
+    finally:
+        speed.close()
+    wm = lineage.parse_watermark(up.headers)
+    assert wm is not None, up.headers
+    assert wm["offsets"] == {"0": 1}
+    assert wm["watermark_ms"] >= before_ms
+    # fed into a tracker, the delta advances the freshness watermark
+    tracker = lineage.LineageTracker()
+    assert tracker.freshness_seconds() == -1.0
+    tracker.delta_consumed(up.headers)
+    assert 0.0 <= tracker.freshness_seconds() < 60.0
+    assert tracker.snapshot()["delta"]["count"] == 1
+
+
+def test_tracker_adoption_timeline_and_anon_models():
+    tracker = lineage.LineageTracker(history=4)
+    assert tracker.live_generation() is None
+    assert tracker.note_query() is None
+    assert tracker.adoption_lag_seconds() == -1.0
+    stamp = _stamp(offsets={0: 9}, watermark_ms=int(time.time() * 1000) - 5_000)
+    gen = tracker.model_consumed(
+        "MODEL", {lineage.PROVENANCE_HEADER: json.dumps(stamp)})
+    assert gen == stamp["generation"]
+    # consumed-but-not-live: adoption lag is LIVE (grows from consume time)
+    assert 0.0 <= tracker.adoption_lag_seconds() < 60.0
+    tracker.mark_staged(gen)
+    tracker.mark_warmed(gen)
+    tracker.mark_live(gen)
+    tracker.mark_live(gen)  # warmer + deadline valve may both report
+    assert tracker.live_generation() == gen
+    # the stamped watermark (5s old) now backs freshness
+    assert 4.0 <= tracker.freshness_seconds() < 60.0
+    assert tracker.note_query() == gen
+    snap = tracker.snapshot()
+    assert snap["live"]["generation"] == gen
+    assert snap["live"]["status"] == "live"
+    for field in ("consumed_at", "staged_at", "warmed_at", "live_at",
+                  "first_query_at"):
+        assert snap["live"][field] is not None, field
+    assert snap["live"]["consumed_at"] <= snap["live"]["live_at"]
+    # an unstamped model (direct test publish) still gets a usable identity
+    anon = tracker.model_consumed("MODEL", None)
+    assert anon.startswith("anon-")
+    tracker.mark_live(anon)
+    assert tracker.note_query() == anon
+    # replaying the stamped MODEL (consumer restart) refreshes, not duplicates
+    again = tracker.model_consumed(
+        "MODEL", {lineage.PROVENANCE_HEADER: json.dumps(stamp)})
+    assert again == gen
+    gens = [g["generation"] for g in tracker.snapshot()["generations"]]
+    assert gens.count(gen) == 1

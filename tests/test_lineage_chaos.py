@@ -1,0 +1,166 @@
+"""Freshness-SLO game day (docs/slo.md + docs/observability.md "Model
+lineage & freshness"): with the freshness objective armed, PAUSE the batch
+tier through the ``oryx.faults`` registry (every generation attempt fails
+through the real quarantine machinery) while the serving watermark ages.
+The burn-rate engine must page within budget, the alert must ride
+``/readyz``'s ``slo_alerts`` and the blackbox flight recorder, and — after
+the batch tier resumes and a fresh generation is adopted — the alert must
+CLEAR without operator action."""
+
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import faults
+from oryx_tpu.common import ioutils
+from oryx_tpu.common import lineage
+from oryx_tpu.common import slo as slo_mod
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+FRESH_SEC = 8.0  # staleness threshold: small enough to trip inside a test
+
+
+def _lines(n_users=30, n_items=20, rank=3, per_user=6):
+    rng = np.random.default_rng(5)
+    scores = (rng.standard_normal((n_users, rank))
+              @ rng.standard_normal((rank, n_items)))
+    return [
+        f"u{u},i{i},1,{u * 1000 + int(i)}"
+        for u in range(n_users)
+        for i in np.argsort(-scores[u])[:per_user]
+    ]
+
+
+def _freshness_alerts(alerts: list) -> list:
+    return [a for a in alerts if a["slo"] == "freshness"]
+
+
+def test_freshness_burn_alert_fires_and_clears_across_batch_pause(tmp_path):
+    tp.reset_memory_brokers()
+    faults.disarm()
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "lineage-chaos",
+            "oryx.batch.update-class":
+                "oryx_tpu.models.als.update.ALSUpdate",
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.serving.api.port": port,
+            "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+            "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+            "oryx.batch.streaming.config.platform": "cpu",
+            "oryx.als.iterations": 3,
+            "oryx.als.hyperparams.features": 6,
+            "oryx.ml.eval.test-fraction": 0.2,
+            "oryx.ml.eval.candidates": 1,
+            "oryx.slo.freshness.enabled": True,
+            "oryx.slo.freshness.threshold-sec": FRESH_SEC,
+            # fast retries so a paused generation quarantines quickly
+            "oryx.resilience.retry.base-delay-ms": 2,
+            "oryx.resilience.retry.max-delay-ms": 20,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    serving = ServingLayer(config)
+    serving.start()
+    batch = BatchLayer(config)
+    producer = tp.TopicProducerImpl("memory:", "OryxInput")
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30)
+    try:
+        # start first: the layer resolves its start offset at the broker
+        # head, so input planted before start() would be skipped
+        batch.start(interval_sec=0.3)
+        for line in _lines():
+            producer.send(None, line)
+        # phase 0 — a stamped generation goes live; freshness becomes known
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if lineage.freshness_seconds() is not None:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("no stamped generation was ever adopted")
+        for _ in range(3):  # healthy baseline samples for the objective
+            slo_mod.status(force=True)
+        assert not _freshness_alerts(slo_mod.active_alerts())
+
+        # phase 1 — PAUSE the batch tier: every generation attempt fails at
+        # the chaos site, so new input quarantines instead of training and
+        # the serving watermark stops advancing
+        faults.arm("batch.generation=fail:100000", seed=1)
+        producer.send(None, f"u0,i19,1,{int(time.time() * 1000)}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            fresh = lineage.freshness_seconds()
+            if fresh is not None and fresh > FRESH_SEC:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("freshness never crossed the threshold under pause")
+        # burn-rate budget: persistent staleness must page within ~15
+        # forced evaluations (bad fraction >> the 14.4 fast threshold)
+        fired = False
+        for _ in range(15):
+            status = slo_mod.status(force=True)
+            if status["freshness"]["alerts"]["page"]:
+                fired = True
+                break
+        assert fired, f"freshness page never fired: {status['freshness']}"
+        # the firing alert is operator-visible everywhere it must be:
+        readyz = client.get("/readyz").json()
+        assert _freshness_alerts(readyz["slo_alerts"]), readyz["slo_alerts"]
+        assert client.get("/readyz").status_code == 200  # informational only
+        bundle = client.get("/debug/bundle").json()
+        edges = [e for e in bundle["events"]
+                 if e["kind"] == "slo.alert" and e.get("slo") == "freshness"
+                 and e.get("active") is True]
+        assert edges, "no slo.alert blackbox event for the freshness page"
+
+        # phase 2 — resume: disarm, feed fresh input, a new generation is
+        # adopted and the watermark catches up
+        faults.disarm()
+        live_before = lineage.tracker().live_generation()
+        producer.send(None, f"u1,i18,1,{int(time.time() * 1000)}")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            fresh = lineage.freshness_seconds()
+            if (lineage.tracker().live_generation() != live_before
+                    and fresh is not None and fresh <= FRESH_SEC):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("batch tier never recovered after the pause")
+        # good evaluations dilute the bad window until the burn drops under
+        # BOTH thresholds (page at 14.4, then the slower ticket at 6) —
+        # the alert clears hands-off, no operator reset
+        cleared = False
+        for _ in range(600):
+            status = slo_mod.status(force=True)
+            if not _freshness_alerts(slo_mod.active_alerts()):
+                cleared = True
+                break
+        assert cleared, f"freshness alerts never cleared: {status['freshness']}"
+        assert not _freshness_alerts(slo_mod.active_alerts())
+        readyz = client.get("/readyz").json()
+        assert not _freshness_alerts(readyz["slo_alerts"])
+        # the clear edge landed in the flight recorder too
+        bundle = client.get("/debug/bundle").json()
+        clears = [e for e in bundle["events"]
+                  if e["kind"] == "slo.alert" and e.get("slo") == "freshness"
+                  and e.get("active") is False]
+        assert clears, "no slo.alert clear event after recovery"
+    finally:
+        faults.disarm()
+        client.close()
+        batch.close()
+        serving.close()
+        tp.reset_memory_brokers()

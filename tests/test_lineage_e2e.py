@@ -1,0 +1,161 @@
+"""End-to-end attributability (the acceptance loop of docs/observability.md
+"Model lineage & freshness"), proven on BOTH persistent transports: plant a
+datum on the input topic, let the REAL BatchLayer train and publish a
+stamped generation through a ``file:`` durable log and a live ``tcp:``
+netbroker, let the REAL ServingLayer adopt it, then close the loop from the
+outside: the ``x-oryx-model-generation`` header on an ordinary HTTP answer
+names a generation whose ``GET /lineage`` provenance offsets COVER the
+planted datum — and the freshness gauge reflects the adoption instead of
+the -1 unknown sentinel."""
+
+import time
+
+import httpx
+import numpy as np
+import pytest
+
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import ioutils
+from oryx_tpu.lambda_rt.batch import BatchLayer
+from oryx_tpu.serving.app import ServingLayer
+from oryx_tpu.transport import topic as tp
+
+
+def _input_lines(n_users=30, n_items=20, rank=3, per_user=6):
+    rng = np.random.default_rng(11)
+    scores = (rng.standard_normal((n_users, rank))
+              @ rng.standard_normal((rank, n_items)))
+    return [
+        f"u{u},i{i},1,{u * 1000 + int(i)}"
+        for u in range(n_users)
+        for i in np.argsort(-scores[u])[:per_user]
+    ]
+
+
+def _metric_value(text: str, name: str) -> "float | None":
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    return None
+
+
+@pytest.mark.parametrize("scheme", ["file", "tcp"])
+def test_planted_datum_is_attributable_end_to_end(scheme, tmp_path):
+    tp.reset_memory_brokers()
+    tp.reset_tcp_clients()
+    server = None
+    if scheme == "file":
+        broker_url = f"file:{tmp_path}/topics"
+    else:
+        from oryx_tpu.transport import netbroker
+
+        server = netbroker.NetBrokerServer(
+            str(tmp_path / "broker"), host="127.0.0.1", port=0,
+        ).start_background()
+        broker_url = f"tcp://127.0.0.1:{server.port}"
+    port = ioutils.choose_free_port()
+    config = cfg.overlay_on(
+        {
+            "oryx.id": f"lineage-e2e-{scheme}",
+            "oryx.input-topic.broker": broker_url,
+            "oryx.update-topic.broker": broker_url,
+            "oryx.batch.update-class":
+                "oryx_tpu.models.als.update.ALSUpdate",
+            "oryx.serving.model-manager-class":
+                "oryx_tpu.models.als.serving.ALSServingModelManager",
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            "oryx.serving.api.port": port,
+            "oryx.batch.storage.data-dir": str(tmp_path / "data"),
+            "oryx.batch.storage.model-dir": str(tmp_path / "model"),
+            "oryx.batch.streaming.config.platform": "cpu",
+            "oryx.als.iterations": 3,
+            "oryx.als.hyperparams.features": 6,
+            "oryx.ml.eval.test-fraction": 0.2,
+            "oryx.ml.eval.candidates": 1,
+        },
+        cfg.get_default(),
+    )
+    tp.maybe_create_topics(config, "input-topic", "update-topic")
+    serving = ServingLayer(config)
+    serving.start()
+    batch = BatchLayer(config)
+    producer = tp.TopicProducerImpl(broker_url, "OryxInput")
+    broker = tp.get_broker(broker_url)
+    try:
+        # the layer consumes from the broker head it resolves in start()
+        # (stored offsets else latest) — plant AFTER start so the datum is
+        # inside the consumed range; stamp offsets are absolute, so the
+        # coverage check below still pins the planted broker position
+        batch.start(interval_sec=0.5)
+        for line in _input_lines():
+            producer.send(None, line)
+        planted_size = broker.size("OryxInput")
+        assert planted_size > 0
+        with httpx.Client(
+            base_url=f"http://127.0.0.1:{port}", timeout=30
+        ) as client:
+            generation = None
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                r = client.get("/recommend/u0?howMany=3")
+                cand = r.headers.get("x-oryx-model-generation")
+                if r.status_code == 200 and cand and not cand.startswith(
+                        "anon-"):
+                    generation = cand
+                    break
+                time.sleep(0.1)
+            assert generation is not None, (
+                f"no stamped generation adopted over {scheme}"
+            )
+            # the loop closer: the response header's generation, looked up
+            # in /lineage, covers the planted input offsets
+            doc = client.get("/lineage").json()
+            assert doc["enabled"] is True
+            rec = next(g for g in doc["generations"]
+                       if g["generation"] == generation)
+            stamp = rec["stamp"]
+            assert stamp is not None, "generation adopted without a stamp"
+            assert int(stamp["offsets"]["0"]) >= planted_size, (
+                f"generation covers {stamp['offsets']} but the datum sits "
+                f"at offset {planted_size - 1}"
+            )
+            assert stamp["origin"] in ("scratch", "resume")
+            assert stamp["new_rows"] > 0
+            # adoption timeline completed through live (+ first query, since
+            # the poll above queried it)
+            assert rec["status"] == "live"
+            assert rec["live_at"] is not None
+            assert rec["first_query_at"] is not None
+            assert doc["live"]["generation"] == generation
+            # ...and the probe routes stay out of the lineage story
+            assert "x-oryx-model-generation" not in client.get(
+                "/healthz").headers
+            # the freshness gauge dropped from the -1 unknown sentinel to
+            # the actual (bounded) data age of the adopted generation
+            metrics_text = client.get("/metrics").text
+            fresh = _metric_value(
+                metrics_text, "oryx_model_data_freshness_seconds")
+            assert fresh is not None and 0.0 <= fresh < 300.0, fresh
+            lag = _metric_value(
+                metrics_text, "oryx_model_adoption_lag_seconds")
+            assert lag is not None and 0.0 <= lag < 300.0, lag
+            # satellite: the update-lag gauge no longer flatlines at 0 while
+            # the consumer idles between batch generations — it reports the
+            # provenance watermark's data age instead
+            update_lag = _metric_value(
+                metrics_text, "oryx_serving_update_lag_seconds")
+            assert update_lag is not None and update_lag > 0.0
+            # the adoption left flight-recorder evidence
+            bundle = client.get("/debug/bundle").json()
+            adopted = [e for e in bundle["events"]
+                       if e["kind"] == "model.adopted"
+                       and e.get("generation") == generation]
+            assert adopted, "no model.adopted blackbox event"
+    finally:
+        batch.close()
+        serving.close()
+        if server is not None:
+            tp.reset_tcp_clients()
+            server.close()
+        tp.reset_memory_brokers()

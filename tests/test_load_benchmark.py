@@ -176,6 +176,51 @@ def test_als_recommend_load_smoke():
     )
 
 
+def test_lineage_freshness_gauges_smoke():
+    """Always-on smoke floor for the lineage gauges (round-17 CI
+    satellite): after one stamped generation goes live, the freshness
+    gauge must be LIVE in the Prometheus exposition (present, parseable,
+    non-negative — not the -1 "unknown" sentinel) and the adoption lag
+    must be bounded — a stamped adoption completing in-process must never
+    report minutes of lag. Guards the scrape-time callback wiring: a
+    broken ``set_function`` hookup renders NaN or the stale -1 and the
+    fleet table would silently lose its freshness column."""
+    import json
+
+    from oryx_tpu.common import config as cfg
+    from oryx_tpu.common import lineage
+    from oryx_tpu.common import metrics as metrics_mod
+
+    tracker = lineage.configure(cfg.get_default())
+
+    class _Ctx:
+        pass
+
+    ctx = _Ctx()
+    now_ms = int(time.time() * 1000)
+    ctx.input_offsets = {0: 5}
+    ctx.input_watermark_ms = now_ms - 2_000
+    stamp = lineage.make_stamp(ctx, now_ms, train_start_ms=now_ms - 500,
+                               train_end_ms=now_ms, new_rows=5, past_rows=0)
+    gen = tracker.model_consumed(
+        "MODEL", {lineage.PROVENANCE_HEADER: json.dumps(stamp)})
+    tracker.mark_live(gen)
+
+    scraped = {}
+    for line in metrics_mod.default_registry().render().splitlines():
+        for name in ("oryx_model_data_freshness_seconds",
+                     "oryx_model_adoption_lag_seconds"):
+            if line.startswith(name + " "):
+                scraped[name] = float(line.split()[-1])
+    fresh = scraped.get("oryx_model_data_freshness_seconds")
+    assert fresh is not None, "freshness gauge missing from the exposition"
+    assert fresh == fresh, "freshness gauge rendered NaN (dead callback)"
+    assert 0.0 <= fresh < 60.0, f"freshness not live/bounded: {fresh}"
+    lag = scraped.get("oryx_model_adoption_lag_seconds")
+    assert lag is not None, "adoption-lag gauge missing from the exposition"
+    assert 0.0 <= lag < 60.0, f"adoption lag unbounded: {lag}"
+
+
 def test_sanitizer_overhead_within_five_percent_of_smoke_call():
     """The concurrency sanitizer's cost on the smoke-benchmark shape must
     stay <= 5% of a device call (ISSUE 11 CI satellite). Measured the
