@@ -215,6 +215,11 @@ def _serving_bench() -> dict:
         http_section = _http_bench(model, queries)
     except Exception as e:  # noqa: BLE001 — optional section
         http_section = {"error": f"{type(e).__name__}: {e}"}
+    # hoist the series to the record top level (round 18): the qps/p99/
+    # queue-depth trajectory over the measurement window, one place for
+    # trace_summary --series and the --history trend column to read
+    history_section = (http_section.pop("history", None)
+                       if isinstance(http_section, dict) else None)
 
     # the 5 slowest spans the round produced (reservoir retention keeps the
     # slowest per route through ring wrap): the p99 note "includes
@@ -287,6 +292,7 @@ def _serving_bench() -> dict:
         "tracing_overhead": tracing_overhead,
         "slowest_traces": slowest_traces,
         "http": http_section,
+        "history": history_section,
     }
 
 
@@ -584,7 +590,13 @@ def _http_bench(model, queries, duration_s: float = 5.0,
     model.bulk_load_users(user_ids, queries[:n_users])
 
     config = cfg.overlay_on(
-        {"oryx.serving.application-resources": "oryx_tpu.serving.resources.als"},
+        {
+            "oryx.serving.application-resources":
+                "oryx_tpu.serving.resources.als",
+            # fast tsdb cadence so the few-second measurement window still
+            # yields a qps/p99/queue-depth series for record["history"]
+            "oryx.tsdb.sample-interval-sec": 0.5,
+        },
         cfg.get_default(),
     )
 
@@ -764,6 +776,15 @@ def _http_bench(model, queries, duration_s: float = 5.0,
             f"active SLO alerts under nominal bench load: {active_alerts} "
             f"(status: {slo_status})"
         )
+    # the tsdb series the sampler recorded across the bench windows
+    # (common/tsdb.py; the 0.5s cadence overlaid above): surfaced as
+    # record["history"] for trace_summary --series / the --history qps~
+    # column
+    from oryx_tpu.common import tsdb
+
+    history_section = tsdb.history_payload(
+        signals=("request_rate", "request_p99_ms", "queue_depth")
+    )["signals"] or None
     return {
         # headline = steady state; the cold split keeps the compile storm
         # visible instead of diluting the p99
@@ -781,6 +802,7 @@ def _http_bench(model, queries, duration_s: float = 5.0,
         "warm_window_zero_compiles": warm_compiles == 0,
         "resilience": resilience_counters,
         "slo": slo_section,
+        "history": history_section,
         "zero_sheds": resilience_counters["shed_requests_total"] == 0,
         "note": "GET /recommend through aiohttp + coalescer, device RTT "
                 "included; cold window contains the batch-size first-compiles",

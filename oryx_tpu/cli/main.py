@@ -171,13 +171,16 @@ def cmd_broker(argv: "list[str]") -> int:
 
 def cmd_fleet_status(argv: "list[str]") -> int:
     """Fleet-wide observability console (common/federation.py): scrape N
-    replicas' ``/metrics`` + ``/readyz`` + ``/trace``, merge them soundly
-    (counters sum, histograms add bucket-wise, gauges keep per-replica
-    labels, down replicas report down), and render an operator table, a
-    merged Prometheus ``fleet`` exposition, or JSON. ``--watch`` re-scrapes
-    on an interval and derives qps/error-rate from the deltas. Replica
-    list from ``--replicas`` (comma-separated, repeatable) or
-    ``oryx.fleet.replicas``. Runbook: docs/slo.md."""
+    replicas' ``/metrics`` + ``/readyz`` + ``/trace`` +
+    ``/metrics/history``, merge them soundly (counters sum, histograms add
+    bucket-wise, gauges keep per-replica labels, down replicas report
+    down), and render an operator table, a merged Prometheus ``fleet``
+    exposition, or JSON. Rate columns prefer a replica's own server-side
+    series from ``/metrics/history`` (with qps/freshness sparkline
+    columns); ``--watch`` re-scrapes on an interval and keeps client-side
+    delta derivation as the fallback for pre-history replicas in a mixed
+    fleet. Replica list from ``--replicas`` (comma-separated, repeatable)
+    or ``oryx.fleet.replicas``. Runbook: docs/slo.md."""
     parser = argparse.ArgumentParser(
         prog="oryx-run fleet-status",
         description="Oryx fleet observability console",
@@ -190,8 +193,8 @@ def cmd_fleet_status(argv: "list[str]") -> int:
     parser.add_argument("--conf", help="HOCON config file overlaid on defaults")
     parser.add_argument(
         "--watch", type=float, default=0.0, metavar="SEC",
-        help="re-scrape every SEC seconds (rate columns come from deltas); "
-             "0 = one shot",
+        help="re-scrape every SEC seconds (rate columns prefer server-side "
+             "/metrics/history series, else scrape deltas); 0 = one shot",
     )
     parser.add_argument(
         "--format", choices=["table", "prom", "json"], default="table",

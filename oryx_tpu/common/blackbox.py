@@ -148,6 +148,7 @@ class _State:
         self._dump_lock = threading.RLock()
         self._wake = threading.Event()
         self._pending_reason: "str | None" = None
+        self._pending_history: "dict | None" = None
         self._dumper: "threading.Thread | None" = None
         self._sigterm_installed = False
 
@@ -201,7 +202,7 @@ def _redacted_props(config) -> dict:
     return out
 
 
-def bundle(reason: str = "on-demand") -> dict:
+def bundle(reason: str = "on-demand", history: "dict | None" = None) -> dict:
     """The one-call postmortem artifact: everything an on-call human wants
     from a dead (or misbehaving) replica, as a single JSON-able dict. Each
     section degrades independently — a broken gauge callback or an
@@ -248,12 +249,26 @@ def bundle(reason: str = "on-demand") -> dict:
         out["slo"] = slo.status()
     except Exception as e:  # noqa: BLE001
         out["slo_error"] = str(e)
+    # pre-incident time series (common/tsdb.py): minutes of context for the
+    # curated signals instead of one snapshot. ``history`` carries a window
+    # captured at TRIGGER time (deferred edge dumps); live pulls read the
+    # rings now. Omitted entirely while the tsdb engine is disabled.
+    try:
+        if history is None:
+            from oryx_tpu.common import tsdb
+
+            history = tsdb.incident_window()
+        if history is not None:
+            out["history"] = history
+    except Exception as e:  # noqa: BLE001
+        out["history_error"] = str(e)
     if _STATE.config_props is not None:
         out["config"] = _STATE.config_props
     return out
 
 
-def dump(reason: str, force: bool = False) -> "str | None":
+def dump(reason: str, force: bool = False,
+         history: "dict | None" = None) -> "str | None":
     """Write one bundle to ``dump-dir`` (atomic tmp+rename via ioutils) and
     GC old dumps down to ``keep``. Rate-limited by ``dump-min-interval-sec``
     unless ``force`` (SIGTERM is forced: the last words must land). Returns
@@ -274,7 +289,9 @@ def dump(reason: str, force: bool = False) -> "str | None":
             from oryx_tpu.common import ioutils
 
             os.makedirs(dump_dir, exist_ok=True)
-            ioutils.atomic_write_text(path, json.dumps(bundle(reason)))
+            ioutils.atomic_write_text(
+                path, json.dumps(bundle(reason, history=history))
+            )
             _STATE.last_dump_path = path
             _DUMPS.labels(reason).inc()
             self_prefix = f"blackbox-{tag}-"
@@ -297,15 +314,25 @@ def dump(reason: str, force: bool = False) -> "str | None":
 def trigger_dump(reason: str) -> None:
     """Ask the background dumper for a dump (non-blocking; no-op without a
     dump-dir). Edge sites call this from under their own locks, so the
-    file I/O must happen on the dumper thread, never inline."""
+    file I/O must happen on the dumper thread, never inline. The series
+    window is captured HERE, at trigger time — a dump deferred past the
+    rate window must still carry the pre-incident context, not a snapshot
+    diluted by the wait (tsdb.incident_window takes only leaf ring locks,
+    so it is as safe under an edge site's lock as the flag-set itself)."""
     if not _STATE.dump_dir:
         return
+    try:
+        from oryx_tpu.common import tsdb
+
+        _STATE._pending_history = tsdb.incident_window()
+    except Exception:  # noqa: BLE001 — context is decoration, never a veto
+        _STATE._pending_history = None
     _STATE._pending_reason = reason
     _STATE._wake.set()
 
 
 def _dumper_loop() -> None:
-    deferred: "str | None" = None
+    deferred: "tuple[str, dict | None] | None" = None
     while True:
         interval = _STATE.dump_interval_sec
         if deferred is not None:
@@ -321,15 +348,20 @@ def _dumper_loop() -> None:
         # edge dump without acting on it
         _STATE._wake.clear()
         reason, _STATE._pending_reason = _STATE._pending_reason, None
-        reason = reason or deferred
+        history, _STATE._pending_history = _STATE._pending_history, None
+        if reason is None and deferred is not None:
+            # retrying a deferred edge dump: keep its TRIGGER-time series
+            # window, not a fresh one — the incident context must not be
+            # diluted by however long the rate limiter made it wait
+            reason, history = deferred
         deferred = None
         if reason is not None:
-            if dump(reason) is None and _STATE.dump_dir:
+            if dump(reason, history=history) is None and _STATE.dump_dir:
                 # rate-limited (or a failed write): DEFER the edge dump,
                 # never drop it — a breaker-open bundle must still land
                 # even when it fired right after the startup dump, and a
                 # kill before the next periodic tick must not erase it
-                deferred = reason
+                deferred = (reason, history)
         elif interval > 0:
             dump("interval")
 
@@ -416,4 +448,5 @@ def reset_for_tests() -> None:
     _STATE.config_props = None
     _STATE.last_dump_path = None
     _STATE._pending_reason = None
+    _STATE._pending_history = None
     _STATE._last_dump_mono = 0.0

@@ -41,6 +41,7 @@ from collections import defaultdict
 # the table's qps/error/latency columns exclude probe/scrape routes with
 # the SAME predicate the SLO engine uses — one contract, two surfaces
 from oryx_tpu.common.slo import is_ops_route as _is_ops_route
+from oryx_tpu.common.textutils import sparkline
 from oryx_tpu.tools.trace_summary import bucket_quantile, parse_metrics_text
 
 DEFAULT_TIMEOUT_SEC = 5.0
@@ -72,6 +73,9 @@ class ReplicaScrape:
         self.readyz: "dict | None" = None
         self.ready = False
         self.trace_stats: "dict | None" = None
+        # /metrics/history payload (round 18) — None on a pre-round-18
+        # replica; every consumer falls back to client-side deltas
+        self.history: "dict | None" = None
 
     @property
     def name(self) -> str:
@@ -123,7 +127,27 @@ def scrape_one(base_url: str, timeout: float = DEFAULT_TIMEOUT_SEC) -> ReplicaSc
         scrape.trace_stats = payload.get("stats")
     except Exception:  # noqa: BLE001 — tracing may be disabled; optional
         scrape.trace_stats = None
+    try:
+        # server-side time series (round 18): optional — a 404 or a body
+        # without a signals dict is simply a replica that predates the
+        # endpoint, and the client-side delta path covers it
+        payload = json.loads(_fetch(f"{base_url}/metrics/history", timeout))
+        if isinstance(payload, dict) and isinstance(
+                payload.get("signals"), dict):
+            scrape.history = payload
+    except Exception:  # noqa: BLE001 — history is optional
+        scrape.history = None
     return scrape
+
+
+def _history_points(scrape: ReplicaScrape, signal: str) -> list:
+    """``[ts, value]`` pairs for one signal from a scrape's history payload
+    (empty on a pre-round-18 replica or an unknown signal)."""
+    hist = getattr(scrape, "history", None) or {}
+    sig = (hist.get("signals") or {}).get(signal) or {}
+    points = sig.get("points") or []
+    return [p for p in points
+            if isinstance(p, (list, tuple)) and len(p) == 2]
 
 
 class FleetSnapshot:
@@ -395,6 +419,21 @@ def replica_row(scrape: ReplicaScrape, prev: "ReplicaScrape | None" = None,
         row["_d_errors"] = d_errors
     else:
         row["qps"] = None
+    # server-side rate (round 18): a replica offering /metrics/history
+    # reports its own sampled request rate — steadier than a client-side
+    # delta and available on the very FIRST scrape. The delta path above
+    # stays the fallback for pre-round-18 replicas in a mixed fleet.
+    rate_points = _history_points(scrape, "request_rate")
+    if rate_points:
+        row["qps"] = float(rate_points[-1][1])
+        row["qps_source"] = "server"
+    else:
+        row["qps_source"] = "client" if row["qps"] is not None else None
+    row["qps_spark"] = sparkline([v for _t, v in rate_points]) or None
+    fresh_points = _history_points(scrape, "freshness_sec")
+    row["fresh_spark"] = sparkline(
+        [v for _t, v in fresh_points if v is not None and v >= 0]
+    ) or None
     p50, p99 = _latency_quantiles(scrape, prev)
     row["p50_ms"] = p50
     row["p99_ms"] = p99
@@ -525,10 +564,10 @@ def render_table(rows: list) -> str:
     """Fixed-width operator table (docs/slo.md "Runbook" reads one)."""
     out = [
         f"{'replica':<24} {'up':>3} {'rdy':>3} {'warm':>7} {'reqs':>9} "
-        f"{'qps':>8} {'err%':>6} {'p50ms':>8} {'p99ms':>8} {'shed':>6} "
-        f"{'degr':>6} {'brk':>3} {'lag':>6} {'mfu%':>6} {'hbm_mb':>8} "
-        f"{'burn':>7} {'alrt':>4} {'budget':>6} {'fresh_s':>8} "
-        f"{'generation':>15}"
+        f"{'qps':>8} {'qps~':>8} {'err%':>6} {'p50ms':>8} {'p99ms':>8} "
+        f"{'shed':>6} {'degr':>6} {'brk':>3} {'lag':>6} {'mfu%':>6} "
+        f"{'hbm_mb':>8} {'burn':>7} {'alrt':>4} {'budget':>6} "
+        f"{'fresh_s':>8} {'fresh~':>8} {'generation':>15}"
     ]
     for r in rows:
         if not r.get("up"):
@@ -544,6 +583,9 @@ def render_table(rows: list) -> str:
             f"{str(r.get('warmup', '-')):>7} "
             f"{_cell(r.get('requests_total'), '{:9.0f}', 9)} "
             f"{_cell(r.get('qps'), '{:8.1f}', 8)} "
+            # sparkline of the replica's server-side history ('-' when the
+            # replica predates /metrics/history)
+            f"{(r.get('qps_spark') or '-'):>8} "
             f"{_cell(r.get('error_pct'), '{:6.2f}', 6)} "
             f"{_cell(r.get('p50_ms'), '{:8.1f}', 8)} "
             f"{_cell(r.get('p99_ms'), '{:8.1f}', 8)} "
@@ -557,6 +599,7 @@ def render_table(rows: list) -> str:
             f"{_cell(r.get('slo_alerts'), '{:4d}', 4)} "
             f"{_cell(r.get('budget_remaining'), '{:6.3f}', 6)} "
             f"{_cell(r.get('fresh_s'), '{:8.1f}', 8)} "
+            f"{(r.get('fresh_spark') or '-'):>8} "
             # a trailing '*' flags generation skew: this replica serves an
             # older generation than the fleet's newest
             f"{(r.get('generation') or '-') + ('*' if r.get('generation_skew') else ''):>15}"

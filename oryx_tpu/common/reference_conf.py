@@ -525,6 +525,64 @@ oryx = {
     keep = 8
   }
 
+  # In-process metrics time-series engine (common/tsdb.py,
+  # docs/observability.md "Time series & trends"): a background sampler
+  # walks the registry each tick and keeps bounded per-signal history
+  # rings — served on GET /metrics/history, embedded as the pre-incident
+  # window in blackbox bundles, and fed to the trend-alert early warning.
+  tsdb = {
+    enabled = true
+    # Sampler tick cadence. 0 disables the background thread (manual
+    # sample_once() ticks and the rings themselves still work).
+    sample-interval-sec = 5
+    # Points newer than this are never decimated — the full-resolution
+    # window every incident capture draws from.
+    full-resolution-sec = 600
+    # Wall-clock horizon: points older than this are dropped on append.
+    # Between full-resolution-sec and here, history thins 2:1 per
+    # decimation pass (tiered; bounded beats pretty).
+    retention-sec = 14400
+    # Point caps. The total cap is enforced as an even per-signal share,
+    # so with the 12 curated signals the defaults hold ~512 points each —
+    # a few hundred KB of floats, the whole engine's memory ceiling.
+    max-points-per-signal = 512
+    max-total-points = 8192
+    # Trailing window embedded in blackbox bundles and edge-triggered
+    # dumps (captured at TRIGGER time for deferred edge dumps).
+    incident-window-sec = 300
+    # Subset of the curated signal names to record ([] = all of them):
+    # request_rate, request_p99_ms, queue_depth, shed_rate,
+    # breaker_degraded_rate, retry_rate, update_lag_sec, freshness_sec,
+    # mfu, hbm_fraction, arena_bytes, host_rss_bytes.
+    signals = []
+    # Trend-aware early warning: least-squares slope over the trailing
+    # window plus threshold-crossing ETA. Active rules raise
+    # oryx_trend_alert_active, ride /readyz informationally, and record
+    # blackbox trend.alert events — firing BEFORE the SLO burn pages.
+    trend = {
+      enabled = true
+      # Slope fit window and the evidence floor below which a rule stays
+      # quiet (two samples of noise must never page).
+      window-sec = 120
+      min-points = 6
+      # "Queue depth ramping such that the cap is reached within
+      # horizon-sec." limit 0 inherits oryx.serving.compute.max-queue-depth
+      # (an unbounded queue has nothing to cross — rule off).
+      queue-depth = {
+        enabled = true
+        horizon-sec = 300
+        limit = 0
+      }
+      # "Data freshness age accelerating past the staleness threshold."
+      # limit 0 inherits oryx.slo.freshness.threshold-sec.
+      freshness = {
+        enabled = true
+        horizon-sec = 300
+        limit = 0
+      }
+    }
+  }
+
   # Framework-wide metrics registry + Prometheus text exposition on
   # GET /metrics (replaces the reference's Spark-UI/JMX metrics story;
   # docs/observability.md has the catalog).

@@ -48,6 +48,7 @@ from bisect import bisect_right
 
 from oryx_tpu.common import blackbox
 from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import tsdb
 
 _BURN = metrics_mod.default_registry().gauge(
     "oryx_slo_burn_rate",
@@ -263,10 +264,6 @@ class SloEngine:
         self.slow_windows = tuple(slow_windows or self.SLOW_WINDOWS)
         self._clock = clock
         self._lock = threading.Lock()
-        # parallel time-ordered arrays (windowing bisects on _times; a
-        # linear scan would walk hours of scrape samples per evaluation)
-        self._times: list[float] = []
-        self._readings: list[dict] = []  # {name: (good, total)} per sample
         self._alerts: dict[tuple, bool] = {}
         self._cached: "dict | None" = None
         self._cached_at = float("-inf")
@@ -286,6 +283,18 @@ class SloEngine:
             ("ticket", tuple(_window_label(w) for w in self.slow_windows),
              self.slow_threshold),
         )
+        # sample history rides the shared series-ring primitive
+        # (common/tsdb.py) in "oldest half" mode — the same horizon trim
+        # and 2:1 count-bound decimation the private parallel arrays did,
+        # now the ONE implementation /metrics/history is also built on, so
+        # burn windows and recorded history can never diverge. lock=False:
+        # every touch is already serialized under self._lock (windowing
+        # bisects on the time column; a linear scan would walk hours of
+        # scrape samples per evaluation).
+        self._history = tsdb.SeriesRing(
+            self._max_window + 60.0, self.MAX_SAMPLES,
+            full_resolution_sec=None, lock=False,
+        )
         # seed a baseline sample at BIRTH: while history is younger than a
         # window, deltas fall back to the oldest sample, and without this
         # seed that would be the FIRST EVALUATION's — anything counted
@@ -293,12 +302,23 @@ class SloEngine:
         # from every window at the second scrape (a burst erroring before
         # the first scrape must stay visible, and an alert it raised must
         # decay on window time, not on scrape cadence)
-        self._times.append(self._clock())
-        self._readings.append({o.name: o.reader() for o in self.objectives})
+        self._history.append(
+            self._clock(), {o.name: o.reader() for o in self.objectives}
+        )
 
     @property
     def windows(self) -> "tuple[float, ...]":
         return tuple(w for w, _label in self._windows_labeled)
+
+    # attribute-shaped views of the ring's columns: pre-migration tests and
+    # tooling reach for eng._times / eng._readings directly
+    @property
+    def _times(self) -> "list[float]":
+        return self._history._times
+
+    @property
+    def _readings(self) -> "list[dict]":
+        return self._history._values
 
     def _delta(self, name: str, now: float, window_sec: float,
                current: tuple) -> tuple:
@@ -306,14 +326,14 @@ class SloEngine:
         cumulative minus the newest sample at least window_sec old (or the
         oldest sample available — see class docstring). One bisect over
         the time-ordered sample array."""
-        times = self._times  # analyze: ignore[lock-discipline] -- _delta runs only under self._lock, taken by evaluate()
+        times = self._times
         if not times:
             base = (0.0, 0.0)
         else:
             # newest index with t <= now - window_sec; -1 -> history is
             # younger than the window -> oldest sample covers it
             i = bisect_right(times, now - window_sec) - 1
-            base = self._readings[max(0, i)].get(name, (0.0, 0.0))  # analyze: ignore[lock-discipline] -- _delta runs only under self._lock, taken by evaluate()
+            base = self._readings[max(0, i)].get(name, (0.0, 0.0))
         return max(0.0, current[0] - base[0]), max(0.0, current[1] - base[1])
 
     def _burn(self, objective: Objective, delta: tuple) -> float:
@@ -373,25 +393,16 @@ class SloEngine:
                     "alerts": alerts,
                 }
             # sample AFTER computing deltas: a window must never compare
-            # the current reading against itself
-            self._times.append(now)
-            self._readings.append(readings)
-            horizon = now - self._max_window - 60.0
-            if self._times[0] < horizon:
-                cut = bisect_right(self._times, horizon)
-                cut = min(cut, len(self._times) - 1)  # keep >= 1 sample
-                if cut > 0:
-                    del self._times[:cut]
-                    del self._readings[:cut]
-            if len(self._times) > self.MAX_SAMPLES:
-                # count bound on top of the time bound: a 1s probe cadence
-                # against a 24h budget window would otherwise retain ~170k
-                # samples. Decimate the OLDEST half — long-window bases
-                # only need coarse granularity there, and window deltas
-                # stay correct (just snapped to a slightly older base).
-                half = len(self._times) // 2
-                self._times[:half] = self._times[:half:2]
-                self._readings[:half] = self._readings[:half:2]
+            # the current reading against itself. The ring applies the
+            # horizon trim (keep >= 1 sample) plus the MAX_SAMPLES
+            # oldest-half 2:1 decimation — a 1s probe cadence against a
+            # 24h budget window would otherwise retain ~170k samples, and
+            # long-window bases only need coarse granularity back there
+            # (window deltas stay correct, just snapped to a slightly
+            # older base). max_points re-reads MAX_SAMPLES so per-instance
+            # overrides keep working.
+            self._history.max_points = int(self.MAX_SAMPLES)
+            self._history.append(now, readings)
             self._cached = status
             self._cached_at = now
             return status

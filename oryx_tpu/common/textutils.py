@@ -63,6 +63,33 @@ def convert_via_json(value: Any, cls: type) -> Any:
     return v
 
 
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[Any], width: int = 8) -> str:
+    """Render the last ``width`` numeric values as a unicode sparkline.
+
+    Non-numeric entries and NaNs are skipped; an empty/all-bad input renders
+    ``""``. A flat series renders the baseline glyph so "no data" and
+    "constant data" stay visually distinct. Used by the fleet-status table
+    and ``trace_summary --series`` to show /metrics/history series inline.
+    """
+    vals = [
+        float(v) for v in values
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v == v
+    ][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_CHARS[0] * len(vals)
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[min(top, int((v - lo) / (hi - lo) * (top + 1)))]
+        for v in vals
+    )
+
+
 def parse_possibly_json(line: str) -> list[str]:
     """Input topic lines may be CSV or a JSON array; sniff and parse
     (mirrors MLFunctions.PARSE_FN, app/oryx-app-common/.../fn/MLFunctions.java)."""

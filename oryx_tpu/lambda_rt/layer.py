@@ -23,6 +23,7 @@ from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
 from oryx_tpu.common import slo
 from oryx_tpu.common import spans
+from oryx_tpu.common import tsdb
 from oryx_tpu.common.tracing import StepTracer
 from oryx_tpu.parallel.mesh import ComputeContext
 from oryx_tpu.transport import netbroker
@@ -71,6 +72,10 @@ class AbstractLayer:
         # replicas — no tier is observability-dark
         blackbox.configure(config)
         slo.configure(config)
+        # time-series sampler (oryx.tsdb.*): batch/speed tiers record the
+        # same curated signal history — their blackbox dumps carry the
+        # pre-incident window exactly like a serving replica's
+        tsdb.configure(config)
         netbroker.configure(config)  # tcp:// client timeouts/frame caps
         tp.configure(config)  # file-broker fsync durability policy
         # trainer cost accounting + memory gauges report through the same

@@ -40,6 +40,7 @@ from oryx_tpu.common import profiling
 from oryx_tpu.common import resilience
 from oryx_tpu.common import slo
 from oryx_tpu.common import spans
+from oryx_tpu.common import tsdb
 from oryx_tpu.serving import resource as rsrc
 from oryx_tpu.transport import netbroker
 from oryx_tpu.transport import topic as tp
@@ -377,6 +378,10 @@ def make_app(config, manager, input_producer=None) -> web.Application:
     # active-alert list) — both per-process, like the metrics registry
     blackbox.configure(config)
     slo.configure(config)
+    # time-series sampler (oryx.tsdb.*): history rings behind
+    # GET /metrics/history, the pre-incident window in blackbox bundles,
+    # and the trend-alert early warning (docs/observability.md)
+    tsdb.configure(config)
     # model-lineage tracker (adoption timeline + freshness watermark behind
     # GET /lineage, the freshness gauges and the x-oryx-model-generation
     # response header)
@@ -466,12 +471,13 @@ def _exempt_canonicals(config) -> frozenset:
 
     ``/healthz``/``/readyz`` are ALWAYS exempt (load balancers cannot speak
     digest, and the probes leak nothing beyond up/down); ``/metrics``,
-    ``/trace``, ``/lineage``, ``/debug/profile``, and ``/debug/bundle``
-    share one auth story — exempt unless ``oryx.metrics.require-auth``."""
+    ``/metrics/history``, ``/trace``, ``/lineage``, ``/debug/profile``, and
+    ``/debug/bundle`` share one auth story — exempt unless
+    ``oryx.metrics.require-auth``."""
     templates = {"/healthz", "/readyz"}
     if not config.get_bool("oryx.metrics.require-auth", False):
-        templates |= {"/metrics", "/trace", "/lineage", "/debug/profile",
-                      "/debug/bundle"}
+        templates |= {"/metrics", "/metrics/history", "/trace", "/lineage",
+                      "/debug/profile", "/debug/bundle"}
     context_path = config.get_string("oryx.serving.api.context-path", "/") or "/"
     prefix = context_path.rstrip("/")
     return frozenset(templates | {prefix + t for t in templates})

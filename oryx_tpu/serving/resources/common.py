@@ -5,7 +5,9 @@ Equivalent of the reference's Ready (app/oryx-app-serving/.../Ready.java:33)
 and ErrorResource (framework/oryx-lambda-serving/.../ErrorResource.java:35);
 /metrics is the Prometheus exposition of the process-wide registry
 (docs/observability.md) — the stand-in for the reference's Spark-UI/JMX
-visibility (SURVEY §5.1). /trace renders the span ring buffer
+visibility (SURVEY §5.1); /metrics/history serves the in-process
+time-series rings behind it (common/tsdb.py). /trace renders the span ring
+buffer
 (common/spans.py): recent spans, the kept-slowest per route, or one whole
 trace by id. /healthz (liveness) and /readyz (readiness: model loaded +
 update-consumer lag under ``oryx.serving.ready-max-lag-sec``) are the
@@ -29,6 +31,7 @@ from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import slo as slo_mod
 from oryx_tpu.common import spans
+from oryx_tpu.common import tsdb
 from oryx_tpu.serving import resource as rsrc
 
 
@@ -106,6 +109,10 @@ async def readyz(request: web.Request) -> web.Response:
     # evaluation takes the engine lock + registry family locks, so it
     # hops to a worker thread like every other blocking probe read.
     detail["slo_alerts"] = await asyncio.to_thread(slo_mod.active_alerts)
+    # trend alerts (common/tsdb.py) ride the same way and are equally
+    # INFORMATIONAL: a replica whose queue depth is ramping toward its cap
+    # needs traffic shifted TO its peers, not a readiness failure
+    detail["trend_alerts"] = tsdb.trend_alerts()
     detail["status"] = "ready" if ok else "unavailable"
     return web.json_response(detail, status=200 if ok else 503)
 
@@ -133,6 +140,30 @@ async def metrics(request: web.Request) -> web.Response:
         else metrics_mod.CONTENT_TYPE
     )
     return web.Response(body=body, headers={"Content-Type": content_type})
+
+
+async def metrics_history(request: web.Request) -> web.Response:
+    """JSON time series from the in-process tsdb rings (common/tsdb.py,
+    docs/observability.md "Time series & trends"): per-signal
+    ``{unit, points: [[ts, value], ...]}`` plus active trend alerts.
+    ``?signal=a,b`` keeps only the named signals; ``?since=<unix-ts>``
+    keeps only points strictly newer (pollers — fleet-status --watch —
+    pass the last ts they saw). Walking the rings takes their locks, so
+    the read hops to a worker thread like every other blocking probe.
+    Auth story = /metrics (exempt unless ``oryx.metrics.require-auth``)."""
+    signal = request.query.get("signal")
+    signals = None
+    if signal:
+        signals = {s for s in signal.replace(",", " ").split() if s}
+    since = None
+    raw_since = request.query.get("since")
+    if raw_since:
+        try:
+            since = float(raw_since)
+        except ValueError as e:
+            raise OryxServingException(400, "bad since") from e
+    payload = await asyncio.to_thread(tsdb.history_payload, signals, since)
+    return web.json_response(payload)
 
 
 async def trace(request: web.Request) -> web.Response:
@@ -252,6 +283,7 @@ def register(app: web.Application) -> None:
     app.router.add_route("HEAD", "/readyz", readyz)
     app.router.add_route("GET", "/error", error)
     app.router.add_route("GET", "/metrics", metrics)
+    app.router.add_route("GET", "/metrics/history", metrics_history)
     app.router.add_route("GET", "/trace", trace)
     app.router.add_route("GET", "/lineage", lineage_view)
     app.router.add_route("POST", "/debug/profile", debug_profile)

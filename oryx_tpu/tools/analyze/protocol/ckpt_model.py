@@ -46,10 +46,10 @@ _CKPT = "oryx_tpu/common/checkpoint.py"
 SITES = {
     "gen_offsets": Site(_BATCH, "BatchLayer._on_generation", 78,
                         "context.input_offsets"),
-    "gen_run": Site(_LAYER, "AbstractLayer._run_generation", 318),
+    "gen_run": Site(_LAYER, "AbstractLayer._run_generation", 323),
     "gen_fault": Site(_LAYER, "AbstractLayer._run_generation", 329,
                       "faults.maybe_fail"),
-    "store_off": Site(_LAYER, "AbstractLayer.store_input_offset", 180),
+    "store_off": Site(_LAYER, "AbstractLayer.store_input_offset", 185),
     "store_call": Site(_LAYER, "AbstractLayer.run_microbatches", 301,
                        "store_input_offset"),
     "fingerprint": Site(_CKPT, "fingerprint", 97,

@@ -27,11 +27,18 @@ Usage:
     python -m oryx_tpu.tools.trace_summary <trace-dir-or-file> [--top N]
         [--track SUBSTR]
     python -m oryx_tpu.tools.trace_summary <metrics-dump-or-url> [--metrics]
+    python -m oryx_tpu.tools.trace_summary <history-json-or-url> --series
     python -m oryx_tpu.tools.trace_summary <server-url-or-trace-json> \
         --trace-id <32-hex id>
     python -m oryx_tpu.tools.trace_summary <bench-batch-json> --batch
     python -m oryx_tpu.tools.trace_summary --history BENCH_r0*.json \
         [--regress-pct 25]
+
+``--series`` renders a ``GET /metrics/history`` dump (common/tsdb.py) as a
+per-signal sparkline plus an n/min/mean/max/last table, with any active
+trend alerts below. The argument is a saved JSON body, a blackbox bundle
+(its embedded ``history`` section is used), a bench record carrying
+``history``, or a server base URL (``/metrics/history`` is appended).
 
 ``--batch`` renders a ``bench_batch.py`` record: throughput/MFU per input
 precision, the fused-vs-unfused Gramian split, the gather/einsum/scatter/
@@ -63,6 +70,8 @@ import os
 import re
 import sys
 from collections import defaultdict
+
+from oryx_tpu.common.textutils import sparkline
 
 _DEVICE_HINTS = ("device", "tpu", "stream", "cpuclient")
 # 'xla' is deliberately NOT a hint: it matches host-side compiler threads
@@ -532,6 +541,69 @@ def render_batch_record(payload: dict, out=None) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --series mode: render a /metrics/history dump (common/tsdb.py)
+# ---------------------------------------------------------------------------
+
+def _series_signals(payload) -> dict:
+    """Signals dict out of any of the shapes that carry one: a
+    /metrics/history body ({"signals": ...}), a blackbox bundle (its
+    "history" section), or a bare {signal: {unit, points}} mapping (what
+    bench.py embeds as record["history"])."""
+    if not isinstance(payload, dict):
+        return {}
+    if isinstance(payload.get("signals"), dict):
+        return payload["signals"]
+    hist = payload.get("history")
+    if isinstance(hist, dict):
+        inner = hist.get("signals", hist)
+        if isinstance(inner, dict):
+            return inner
+    if payload and all(
+            isinstance(v, dict) and "points" in v for v in payload.values()):
+        return payload
+    return {}
+
+
+def render_series(payload: dict, out=None) -> int:
+    """Per-signal sparkline + n/min/mean/max/last table for a
+    /metrics/history dump, active trend alerts appended. Returns 2 when
+    the payload carries no signals (wrong file, or tsdb disabled)."""
+    out = out if out is not None else sys.stdout
+    w = out.write
+    signals = _series_signals(payload)
+    if not signals:
+        w("series: no signals in payload (tsdb disabled, or not a "
+          "/metrics/history dump)\n")
+        return 2
+    w(f"{'signal':<24} {'n':>5} {'min':>12} {'mean':>12} {'max':>12} "
+      f"{'last':>12} {'unit':>10}  trend\n")
+    for name in sorted(signals):
+        sig = signals[name] if isinstance(signals[name], dict) else {}
+        vals = [
+            float(p[1]) for p in sig.get("points") or []
+            if isinstance(p, (list, tuple)) and len(p) == 2
+            and isinstance(p[1], (int, float))
+        ]
+        if not vals:
+            w(f"{name:<24} {0:>5} {'-':>12} {'-':>12} {'-':>12} {'-':>12} "
+              f"{str(sig.get('unit', '-')):>10}\n")
+            continue
+        w(f"{name:<24} {len(vals):>5} {min(vals):>12.3f} "
+          f"{sum(vals) / len(vals):>12.3f} {max(vals):>12.3f} "
+          f"{vals[-1]:>12.3f} {str(sig.get('unit', '-')):>10}  "
+          f"{sparkline(vals, width=32)}\n")
+    alerts = payload.get("trend_alerts")
+    if not isinstance(alerts, list):
+        alerts = (payload.get("history") or {}).get("trend_alerts") or []
+    for a in alerts:
+        if isinstance(a, dict):
+            w(f"TREND ALERT: {a.get('rule')} on {a.get('signal')}: "
+              f"current {a.get('current')} -> limit {a.get('limit')} "
+              f"(eta {a.get('eta_sec')}s)\n")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --history mode: the BENCH_*.json round-over-round trajectory
 # ---------------------------------------------------------------------------
 
@@ -645,7 +717,20 @@ def _history_row(label: str, rec: dict) -> dict:
         # BENCH rounds have no cell, and a None cell never compares — the
         # standing gate stays green across the column's introduction.
         "ttm_s": _num((rec.get("lineage") or {}).get("value")),
+        # round-18 history section (record["history"], common/tsdb.py):
+        # the serving bench's qps trajectory over its measurement window
+        # as a sparkline. Same backward tolerance as ttm_s: pre-18 BENCH
+        # rounds have no key and render "-".
+        "qps_trend": _qps_trend(rec),
     }
+
+
+def _qps_trend(rec: dict) -> "str | None":
+    signals = _series_signals(rec.get("history") or {})
+    sig = signals.get("request_rate") or {}
+    vals = [p[1] for p in sig.get("points") or []
+            if isinstance(p, (list, tuple)) and len(p) == 2]
+    return sparkline(vals) or None
 
 
 def render_history(records: list, regress_pct: float = 25.0,
@@ -667,7 +752,7 @@ def render_history(records: list, regress_pct: float = 25.0,
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
       f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s} {'ckpt_ov':>7s} "
       f"{'resume_sv':>9s} {'burn':>6s} {'budget':>6s} {'alrt':>4s} "
-      f"{'ttm_s':>7s}\n")
+      f"{'ttm_s':>7s} {'qps~':>8s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -688,7 +773,8 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['slo_burn'], '{:6.2f}', 6)} "
           f"{cell(r['slo_budget'], '{:6.3f}', 6)} "
           f"{cell(r['slo_alerts'], '{:4d}', 4)} "
-          f"{cell(r['ttm_s'], '{:6.1f}s', 7)}\n")
+          f"{cell(r['ttm_s'], '{:6.1f}s', 7)} "
+          f"{(r['qps_trend'] or '-'):>8s}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]
@@ -733,6 +819,7 @@ def main(argv: "list[str] | None" = None) -> int:
     track_filter = None
     force_metrics = False
     force_batch = False
+    series = False
     history = False
     regress_pct = 25.0
     trace_id = None
@@ -774,12 +861,22 @@ def main(argv: "list[str] | None" = None) -> int:
         if "--metrics" in args:
             force_metrics = True
             args.remove("--metrics")
+        if "--series" in args:
+            series = True
+            args.remove("--series")
         if len(args) != 1:
             raise ValueError("expected exactly one trace path")
     except (IndexError, ValueError):
         print(__doc__, file=sys.stderr)
         return 2
     path = args[0]
+    if series:
+        # a server base URL gets the endpoint path appended; a file is a
+        # saved body / bundle / bench record (all shapes render)
+        if (path.startswith(("http://", "https://"))
+                and "/metrics/history" not in path):
+            path = path.rstrip("/") + "/metrics/history"
+        return render_series(json.loads(_read_metrics_arg(path)))
     if force_batch:
         # file or URL, like every other argument form in this tool
         return render_batch_record(json.loads(_read_metrics_arg(path)))

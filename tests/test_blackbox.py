@@ -173,6 +173,57 @@ def test_rate_limited_edge_dump_is_deferred_not_dropped(tmp_path):
         time.sleep(0.05)
 
 
+def test_deferred_edge_dump_captures_series_at_trigger_time(tmp_path):
+    """A deferred edge dump must embed the time-series window captured at
+    TRIGGER time, not at deferred-write time — the pre-incident context is
+    the whole point, and minutes can pass before the rate window opens."""
+    from oryx_tpu.common import tsdb
+
+    config = cfg.overlay_on(
+        {
+            "oryx.id": "trigger-capture-test",
+            "oryx.blackbox.dump-dir": str(tmp_path),
+            "oryx.blackbox.dump-interval-sec": 0,
+            "oryx.blackbox.dump-min-interval-sec": 1,
+            "oryx.tsdb.sample-interval-sec": 0,  # manual ticks only
+        },
+        cfg.get_default(),
+    )
+    try:
+        # reconfigure CARRIES ring history by design; this test needs an
+        # empty engine so the dumped window is exactly the points below
+        tsdb.reset_for_tests()
+        tsdb.configure(config)
+        blackbox.configure(config)  # startup dump arms the rate window
+        deadline = time.monotonic() + 10
+        while not any(
+            f.endswith("-startup.json") for f in os.listdir(tmp_path)
+        ):
+            assert time.monotonic() < deadline, os.listdir(tmp_path)
+            time.sleep(0.05)
+        ring = tsdb.engine().rings["queue_depth"]
+        ring.append(time.time(), 111.0)  # pre-incident state
+        blackbox.record_event("breaker.transition", dump=True, to="open")
+        # the incident is over; the series has long moved on by the time
+        # the rate window lets the deferred dump through
+        ring.append(time.time(), 222.0)
+        deadline = time.monotonic() + 10
+        while not any(
+            f.endswith("-breaker.transition.json")
+            for f in os.listdir(tmp_path)
+        ):
+            assert time.monotonic() < deadline, os.listdir(tmp_path)
+            time.sleep(0.05)
+        name = next(f for f in os.listdir(tmp_path)
+                    if f.endswith("-breaker.transition.json"))
+        dumped = json.loads((tmp_path / name).read_text())
+        values = [v for _t, v in
+                  dumped["history"]["signals"]["queue_depth"]["points"]]
+        assert values == [111.0], values  # trigger-time, not write-time
+    finally:
+        tsdb.reset_for_tests()
+
+
 def test_min_interval_floors_edge_storms(tmp_path):
     config = cfg.overlay_on(
         {

@@ -90,6 +90,9 @@ def chaos_pair(request, tmp_path_factory):
             "oryx.speed.streaming.config.platform": "cpu",
             # chaos-tuned shapes: fast retries, a breaker that opens after 2
             # failures and probes every 300ms, fast consumer resurrection
+            # — and a fast time-series cadence so bundles captured inside
+            # the test budget still carry a dense pre-incident window
+            "oryx.tsdb.sample-interval-sec": 0.05,
             "oryx.resilience.retry.base-delay-ms": 2,
             "oryx.resilience.retry.max-delay-ms": 20,
             "oryx.resilience.breaker.failure-threshold": 2,
@@ -430,6 +433,40 @@ def test_chaos_breaker_opens_degrades_and_recloses(chaos_pair):
                           breaker="serving.device_call", to="open")
     assert _bundle_events(client, "breaker.transition",
                           breaker="serving.device_call", to="closed")
+
+
+def test_chaos_bundle_carries_pre_incident_series(chaos_pair):
+    """Post-incident bundles are not one snapshot: the history section must
+    declare a multi-minute window and hold a dense series per signal, so
+    the breaker-open postmortem can see the minutes BEFORE the trip (ISSUE
+    18 acceptance: >= 2 min window, >= 10 points per sampled signal)."""
+    client, serving, speed, user, broker_url = chaos_pair
+    # the sampler ticks every 50ms; wait until the always-on gauge signals
+    # have accrued a dense series, then pull the bundle
+    history = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        history = client.get("/debug/bundle").json().get("history")
+        if history and all(
+            len(history["signals"].get(s, {}).get("points", [])) >= 10
+            for s in ("queue_depth", "update_lag_sec", "freshness_sec")
+        ):
+            break
+        time.sleep(0.2)
+    assert history, "bundle carries no time-series history section"
+    assert history["window_sec"] >= 120.0
+    assert history["sample_interval_sec"] == pytest.approx(0.05)
+    for signal in ("queue_depth", "update_lag_sec", "freshness_sec"):
+        points = history["signals"][signal]["points"]
+        assert len(points) >= 10, f"{signal} series too sparse: {points}"
+        assert points == sorted(points)
+    # the same series are live on the console endpoint, filters intact
+    r = client.get("/metrics/history", params={"signal": "queue_depth"})
+    assert r.status_code == 200
+    payload = r.json()
+    assert payload["enabled"] is True
+    assert set(payload["signals"]) == {"queue_depth"}
+    assert len(payload["signals"]["queue_depth"]["points"]) >= 10
 
 
 def test_chaos_generation_quarantine_leaves_event_and_layer_lives(chaos_pair):

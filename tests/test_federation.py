@@ -214,3 +214,47 @@ def test_replica_without_lineage_gauges_has_blank_columns():
     assert rows[0]["generation_skew"] is False
     assert rows[-1]["generation"] is None
     fed.render_table(rows)  # renders without raising
+
+
+def test_server_side_history_beats_client_deltas_and_sparklines_render():
+    """A replica offering /metrics/history gets its qps from the SERVER's
+    request_rate series (no two-scrape warm-up, no client window skew) and
+    grows sparkline columns; replicas without it (pre-round-18, mid
+    rollout) keep the client-delta fallback in the same table."""
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    r1.history = {
+        "enabled": True,
+        "signals": {
+            "request_rate": {"unit": "req/s",
+                             "points": [[100.0, 2.0], [105.0, 4.0],
+                                        [110.0, 8.0], [115.0, 16.0]]},
+            "freshness_sec": {"unit": "sec",
+                              "points": [[100.0, -1.0], [110.0, 30.0],
+                                         [115.0, 12.0]]},
+        },
+        "trend_alerts": [],
+    }
+    r2 = _scrape_from_text("http://b:2", T_BASE)  # no history endpoint
+    rows = fed.table_rows(fed.FleetSnapshot([r1, r2]))
+    assert rows[0]["qps"] == 16.0            # last server-side point
+    assert rows[0]["qps_source"] == "server"
+    assert rows[0]["qps_spark"]              # non-empty sparkline
+    assert rows[0]["fresh_spark"]
+    assert rows[1]["qps"] is None            # no prev snapshot: no delta
+    assert rows[1]["qps_source"] is None
+    assert rows[1]["qps_spark"] is None
+    text = fed.render_table(rows)
+    assert "qps~" in text and "fresh~" in text
+    assert rows[0]["qps_spark"] in text
+
+
+def test_server_history_unknown_freshness_is_filtered_from_sparkline():
+    # the -1 "unknown" sentinel must not flatten the freshness sparkline
+    r1 = _scrape_from_text("http://a:1", T_BASE)
+    r1.history = {"enabled": True, "signals": {
+        "freshness_sec": {"unit": "sec",
+                          "points": [[100.0, -1.0], [110.0, -1.0]]},
+    }, "trend_alerts": []}
+    rows = fed.table_rows(fed.FleetSnapshot([r1]))
+    assert rows[0]["fresh_spark"] is None  # nothing known yet
+    assert rows[0]["qps_source"] is None   # no request_rate series either

@@ -1,0 +1,439 @@
+"""Time-series engine (common/tsdb.py): ring decimation bit-accuracy at
+every tier boundary, half-mode parity with the legacy SLO algorithm, the
+crossing-ETA math, the trend-rule matrix (ramp fires / flat and noisy stay
+quiet), registry sampling (rates, bucket-delta p99, ops-route exclusion),
+edge events + gauges, configure/reconfigure/sampler lifecycle, and the
+"trend alert strictly precedes the SLO page" drill."""
+
+import math
+import time
+
+import pytest
+
+from oryx_tpu.common import blackbox
+from oryx_tpu.common import config as cfg
+from oryx_tpu.common import metrics as metrics_mod
+from oryx_tpu.common import slo
+from oryx_tpu.common import tsdb
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    blackbox.reset_for_tests()
+    tsdb.reset_for_tests()
+    yield
+    tsdb.reset_for_tests()
+    blackbox.reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- SeriesRing ----------------------------------------------------------------
+
+
+def test_full_resolution_tier_is_bit_accurate_at_every_boundary():
+    """After EVERY append: survivors are exact appended (ts, value) pairs
+    (decimation selects, never averages), order holds, the cap holds, and
+    the full-resolution tail is retained verbatim."""
+    ring = tsdb.SeriesRing(1000.0, max_points=32, full_resolution_sec=10.0)
+    appended = []
+    for i in range(200):
+        ts, v = float(i), i + 0.5
+        ring.append(ts, v)
+        appended.append((ts, v))
+        pts = ring.points()
+        assert len(pts) <= 32
+        assert pts == sorted(pts)
+        assert set(pts) <= set(appended)  # bit accuracy: no synthesis
+        # every point inside the full-resolution window survives verbatim
+        tail = [p for p in appended if p[0] >= ts - 10.0]
+        assert pts[-len(tail):] == tail
+    # old tier actually coarsened: average spacing out there grew past 1s
+    old = [t for t, _ in ring.points() if t < 190.0]
+    assert len(old) >= 2
+    assert (old[-1] - old[0]) / (len(old) - 1) > 1.5
+
+
+def test_half_mode_matches_legacy_slo_decimation_exactly():
+    ring = tsdb.SeriesRing(3700.0, max_points=16, full_resolution_sec=None)
+    legacy_t, legacy_v = [], []
+    for i in range(300):
+        ts, v = float(i * 2), {"availability": (i, i + 1)}
+        ring.append(ts, v)
+        # the pre-migration slo.py block, verbatim
+        legacy_t.append(ts)
+        legacy_v.append(v)
+        horizon = ts - 3700.0
+        if legacy_t[0] < horizon:
+            cut = min(len(legacy_t) - 1, 1)  # unreachable in this range
+            del legacy_t[:cut]
+            del legacy_v[:cut]
+        if len(legacy_t) > 16:
+            half = len(legacy_t) // 2
+            legacy_t[:half] = legacy_t[:half:2]
+            legacy_v[:half] = legacy_v[:half:2]
+        assert ring._times == legacy_t
+        assert ring._values == legacy_v
+
+
+def test_horizon_trim_keeps_at_least_one_point():
+    ring = tsdb.SeriesRing(10.0, max_points=100)
+    ring.append(0.0, 1.0)
+    ring.append(1000.0, 2.0)  # first point is far past the horizon
+    assert ring.points() == [(1000.0, 2.0)]
+    ring2 = tsdb.SeriesRing(10.0, max_points=100)
+    ring2.append(0.0, 7.0)
+    assert len(ring2) == 1  # a lone stale point still answers last()
+    assert ring2.last() == (0.0, 7.0)
+
+
+def test_points_since_is_strictly_newer():
+    ring = tsdb.SeriesRing(1000.0)
+    for i in range(5):
+        ring.append(float(i), float(i))
+    assert ring.points(since=2.0) == [(3.0, 3.0), (4.0, 4.0)]
+    assert ring.points(since=None) == [(float(i), float(i))
+                                       for i in range(5)]
+
+
+def test_cap_wins_even_inside_full_resolution_window():
+    # whole ring younger than full-resolution: bounded beats pretty
+    ring = tsdb.SeriesRing(1000.0, max_points=4, full_resolution_sec=900.0)
+    for i in range(10):
+        ring.append(float(i), float(i))
+    assert len(ring) <= 4
+    assert ring.last() == (9.0, 9.0)
+
+
+# -- crossing ETA --------------------------------------------------------------
+
+
+def test_crossing_eta_pinned_math():
+    slope, eta = tsdb.crossing_eta([(0.0, 0.0), (10.0, 5.0)], 20.0)
+    assert slope == pytest.approx(0.5)
+    assert eta == pytest.approx(30.0)  # (20 - 5) / 0.5
+
+
+def test_crossing_eta_edge_cases():
+    assert tsdb.crossing_eta([], 10.0) == (0.0, float("inf"))
+    assert tsdb.crossing_eta([(0.0, 3.0)], 10.0) == (0.0, float("inf"))
+    assert tsdb.crossing_eta([(0.0, 12.0)], 10.0) == (0.0, 0.0)
+    # flat and falling series never cross
+    _s, eta = tsdb.crossing_eta([(0.0, 5.0), (10.0, 5.0)], 10.0)
+    assert eta == float("inf")
+    _s, eta = tsdb.crossing_eta([(0.0, 8.0), (10.0, 2.0)], 10.0)
+    assert eta == float("inf")
+    # already at/over the limit: ETA 0 regardless of slope
+    _s, eta = tsdb.crossing_eta([(0.0, 5.0), (10.0, 15.0)], 10.0)
+    assert eta == 0.0
+
+
+# -- trend rules ---------------------------------------------------------------
+
+
+def _rule(**kw):
+    kw.setdefault("name", "queue_depth")
+    kw.setdefault("signal", "queue_depth")
+    kw.setdefault("limit", 100.0)
+    kw.setdefault("horizon_sec", 300.0)
+    kw.setdefault("window_sec", 120.0)
+    kw.setdefault("min_points", 3)
+    return tsdb.TrendRule(**kw)
+
+
+def test_trend_rule_fires_on_ramp():
+    ring = tsdb.SeriesRing(1000.0)
+    for i in range(6):
+        ring.append(100.0 + 10 * i, 10.0 + 10.0 * i)  # +1/sec toward 100
+    state = _rule().evaluate(ring, 150.0)
+    assert state["active"] is True
+    assert state["slope"] == pytest.approx(1.0)
+    assert state["eta_sec"] == pytest.approx(40.0)  # (100 - 60) / 1
+
+
+def test_trend_rule_quiet_on_flat_and_noisy_and_far():
+    flat = tsdb.SeriesRing(1000.0)
+    noisy = tsdb.SeriesRing(1000.0)
+    far = tsdb.SeriesRing(1000.0)
+    jitter = (0.4, -0.3, 0.2, -0.4, 0.3, -0.2)
+    for i in range(6):
+        flat.append(100.0 + 10 * i, 50.0)
+        noisy.append(100.0 + 10 * i, 50.0 + jitter[i])
+        far.append(100.0 + 10 * i, 1.0 + 0.01 * i)  # crosses in ~3 hours
+    assert _rule().evaluate(flat, 150.0)["active"] is False
+    assert _rule().evaluate(noisy, 150.0)["active"] is False
+    assert _rule().evaluate(far, 150.0)["active"] is False
+
+
+def test_trend_rule_needs_min_points():
+    ring = tsdb.SeriesRing(1000.0)
+    ring.append(100.0, 99.0)
+    ring.append(110.0, 99.5)
+    assert _rule().evaluate(ring, 110.0) is None  # 2 < min_points=3
+    # points outside the window don't count as evidence either
+    for i in range(10):
+        ring.append(200.0 + i, 99.0)
+    assert _rule(window_sec=5.0, min_points=6).evaluate(ring, 209.0) is None
+
+
+# -- engine sampling -----------------------------------------------------------
+
+
+def _private_registry():
+    reg = metrics_mod.MetricsRegistry()
+    q = reg.gauge("oryx_coalescer_queue_depth", "test")
+    shed = reg.counter("oryx_shed_requests_total", "test")
+    hist = reg.histogram("oryx_serving_request_latency_seconds", "test",
+                         ("route",))
+    return reg, q, shed, hist
+
+
+def test_engine_samples_gauges_rates_and_bucket_delta_p99():
+    reg, q, shed, hist = _private_registry()
+    eng = tsdb.TsdbEngine(
+        registry=reg, interval_sec=1.0,
+        signals=("queue_depth", "shed_rate", "request_rate",
+                 "request_p99_ms"),
+    )
+    q.set(5.0)
+    for _ in range(100):
+        hist.labels("/v1/recommend").observe(0.004)
+    first = eng.sample_once(now=1000.0)
+    assert first["queue_depth"] == 5.0
+    assert "shed_rate" not in first       # rates need a previous tick
+    assert "request_rate" not in first
+    q.set(7.0)
+    shed.inc(20.0)
+    for _ in range(100):
+        hist.labels("/v1/recommend").observe(0.004)
+    for _ in range(50):
+        hist.labels("/metrics").observe(0.5)  # ops route: excluded
+    second = eng.sample_once(now=1010.0)
+    assert second["queue_depth"] == 7.0
+    assert second["shed_rate"] == pytest.approx(2.0)      # 20 / 10s
+    assert second["request_rate"] == pytest.approx(10.0)  # 100 / 10s
+    # all 100 delta observations sit in the (0.0025, 0.005] bucket:
+    # p99 interpolates to 0.0025 + 0.0025 * 99/100 sec -> ms
+    assert second["request_p99_ms"] == pytest.approx(4.975)
+    assert eng.rings["queue_depth"].points() == [(1000.0, 5.0),
+                                                 (1010.0, 7.0)]
+
+
+def test_engine_tolerates_missing_families_and_unknown_signals():
+    reg = metrics_mod.MetricsRegistry()  # nothing registered at all
+    eng = tsdb.TsdbEngine(registry=reg, signals=("queue_depth", "nope"))
+    assert set(eng.rings) == {"queue_depth"}
+    assert eng.sample_once(now=1000.0) == {}
+
+
+def test_engine_skips_nan_gauge():
+    reg = metrics_mod.MetricsRegistry()
+    g = reg.gauge("oryx_coalescer_queue_depth", "test")
+    g.set_function(lambda: (_ for _ in ()).throw(RuntimeError("dead")))
+    eng = tsdb.TsdbEngine(registry=reg, signals=("queue_depth",))
+    assert eng.sample_once(now=1000.0) == {}
+    assert len(eng.rings["queue_depth"]) == 0
+
+
+def test_trend_edges_flip_gauge_and_record_blackbox_events():
+    reg, q, _shed, _hist = _private_registry()
+    eng = tsdb.TsdbEngine(
+        registry=reg, signals=("queue_depth",),
+        trend_rules=[_rule(window_sec=60.0, horizon_sec=600.0)],
+    )
+    for i, v in enumerate((10.0, 30.0, 50.0, 70.0, 90.0)):
+        q.set(v)
+        eng.sample_once(now=1000.0 + 5.0 * i)
+    assert dict(tsdb._TREND_ACTIVE.samples())[("queue_depth",)] == 1.0
+    alerts = eng.trend_alerts()
+    assert len(alerts) == 1
+    assert alerts[0]["rule"] == "queue_depth"
+    assert alerts[0]["eta_sec"] == pytest.approx(2.5, abs=0.1)
+    assert "active" not in alerts[0]  # JSON payload drops the bool
+    events = [e for e in blackbox.events() if e["kind"] == "trend.alert"]
+    assert len(events) == 1  # an edge, not a repeat per tick
+    assert events[0]["severity"] == "warning"
+    assert events[0]["signal"] == "queue_depth"
+    # ramp down -> slope flips negative -> clear edge
+    for i, v in enumerate((70.0, 50.0, 30.0, 10.0, 5.0, 5.0)):
+        q.set(v)
+        eng.sample_once(now=1030.0 + 5.0 * i)
+    assert dict(tsdb._TREND_ACTIVE.samples())[("queue_depth",)] == 0.0
+    assert eng.trend_alerts() == []
+    clears = [e for e in blackbox.events() if e["kind"] == "trend.clear"]
+    assert len(clears) == 1
+
+
+def test_history_and_incident_window_shapes():
+    reg, q, _shed, _hist = _private_registry()
+    clock = FakeClock(1000.0)
+    eng = tsdb.TsdbEngine(registry=reg, interval_sec=1.0,
+                          signals=("queue_depth", "shed_rate"),
+                          incident_window_sec=300.0, clock=clock)
+    for i in range(10):
+        q.set(float(i))
+        eng.sample_once()
+        clock.advance(60.0)
+    hist = eng.history()
+    assert set(hist) == {"queue_depth", "shed_rate"}
+    assert hist["queue_depth"]["unit"] == "items"
+    assert len(hist["queue_depth"]["points"]) == 10
+    only = eng.history(signals=("queue_depth",))
+    assert set(only) == {"queue_depth"}
+    newer = eng.history(since=1240.0)
+    assert [p[0] for p in newer["queue_depth"]["points"]] == [
+        1300.0, 1360.0, 1420.0, 1480.0, 1540.0]
+    win = eng.incident_window()
+    assert win["window_sec"] == 300.0
+    assert win["captured_at"] == clock.t
+    assert win["sample_interval_sec"] == 1.0
+    assert win["trend_alerts"] == []
+    # trailing 300s only, strictly newer than the 1300.0 boundary
+    assert len(win["signals"]["queue_depth"]["points"]) == 4
+
+
+# -- module lifecycle ----------------------------------------------------------
+
+
+def _config(**overrides):
+    overrides.setdefault("oryx.tsdb.sample-interval-sec", 0.0)  # no thread
+    return cfg.overlay_on(overrides, cfg.get_default())
+
+
+def test_configure_defaults():
+    eng = tsdb.configure(_config())
+    assert eng is tsdb.engine()
+    assert tsdb.enabled()
+    assert set(eng.rings) == set(tsdb.CURATED_SIGNALS)
+    # queue-depth rule stays off (max-queue-depth defaults to unbounded);
+    # freshness inherits the SLO threshold
+    assert [r.name for r in eng.trend_rules] == ["freshness"]
+    assert eng.trend_rules[0].limit == pytest.approx(600.0)
+
+
+def test_configure_disabled_and_payload_shape():
+    assert tsdb.configure(_config(**{"oryx.tsdb.enabled": False})) is None
+    assert not tsdb.enabled()
+    assert tsdb.history_payload() == {
+        "enabled": False, "signals": {}, "trend_alerts": []}
+    assert tsdb.incident_window() is None
+    assert tsdb.trend_alerts() == []
+    assert tsdb.sample_once() is None
+
+
+def test_configure_queue_rule_inherits_batcher_bound():
+    eng = tsdb.configure(_config(**{
+        "oryx.serving.compute.max-queue-depth": 64}))
+    names = {r.name: r for r in eng.trend_rules}
+    assert names["queue_depth"].limit == pytest.approx(64.0)
+    explicit = tsdb.configure(_config(**{
+        "oryx.tsdb.trend.queue-depth.limit": 12.5}))
+    assert {r.name: r for r in explicit.trend_rules}[
+        "queue_depth"].limit == pytest.approx(12.5)
+
+
+def test_configure_signal_subset_and_per_signal_cap():
+    eng = tsdb.configure(_config(**{
+        "oryx.tsdb.signals": ["queue_depth", "request_rate"],
+        "oryx.tsdb.max-total-points": 100,
+        "oryx.tsdb.max-points-per-signal": 512}))
+    assert set(eng.rings) == {"queue_depth", "request_rate"}
+    assert all(r.max_points == 50 for r in eng.rings.values())
+
+
+def test_reconfigure_carries_ring_history():
+    eng = tsdb.configure(_config())
+    eng.rings["queue_depth"].append(1000.0, 5.0)
+    eng.rings["queue_depth"].append(1001.0, 6.0)
+    eng2 = tsdb.configure(_config())
+    assert eng2 is not eng
+    assert eng2 is tsdb.engine()
+    assert eng2.rings["queue_depth"].points() == [(1000.0, 5.0),
+                                                  (1001.0, 6.0)]
+
+
+def test_background_sampler_ticks_and_reset_joins_it():
+    before = sum(v for _k, v in tsdb._TICKS.samples())
+    tsdb.configure(_config(**{"oryx.tsdb.sample-interval-sec": 0.02}))
+    sampler = tsdb._SAMPLER
+    assert sampler is not None and sampler.is_alive()
+    assert sampler.daemon
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if sum(v for _k, v in tsdb._TICKS.samples()) >= before + 2:
+            break
+        time.sleep(0.01)
+    assert sum(v for _k, v in tsdb._TICKS.samples()) >= before + 2
+    tsdb.reset_for_tests()
+    assert tsdb._SAMPLER is None
+    assert not sampler.is_alive()
+
+
+def test_history_payload_round_trips_through_module():
+    tsdb.configure(_config())
+    tsdb.engine().rings["queue_depth"].append(1000.0, 3.0)
+    payload = tsdb.history_payload(signals=("queue_depth",))
+    assert payload["enabled"] is True
+    assert payload["signals"]["queue_depth"]["points"] == [[1000.0, 3.0]]
+    assert payload["trend_alerts"] == []
+    import json
+    json.dumps(payload)  # must be JSON-clean as served
+
+
+# -- the early-warning promise -------------------------------------------------
+
+
+class _FakeCounter:
+    def __init__(self):
+        self.good = 0.0
+        self.total = 0.0
+
+    def add(self, good: float, bad: float = 0.0) -> None:
+        self.good += good
+        self.total += good + bad
+
+    def read(self):
+        return self.good, self.total
+
+
+def test_trend_alert_fires_strictly_before_slo_page():
+    """The ramped-load drill: queue depth climbing toward its bound raises
+    the trend alert while availability is still clean; only once the damage
+    actually lands does the burn page — and the blackbox event order proves
+    the early warning came first."""
+    reg, q, _shed, _hist = _private_registry()
+    eng = tsdb.TsdbEngine(
+        registry=reg, signals=("queue_depth",),
+        trend_rules=[_rule(window_sec=120.0, horizon_sec=600.0)],
+    )
+    clock = FakeClock(5000.0)
+    counter = _FakeCounter()
+    slo_eng = slo.SloEngine(
+        [slo.Objective("availability", 99.0, 3600.0, counter.read)],
+        clock=clock, min_events=1, min_eval_interval_sec=0.0)
+    slo_eng.evaluate()           # baseline sample, all healthy
+    counter.add(good=100.0)
+    clock.advance(10.0)
+    slo_eng.evaluate()
+    for i, v in enumerate((10.0, 30.0, 50.0, 70.0, 90.0)):  # the ramp
+        q.set(v)
+        eng.sample_once(now=5000.0 + 5.0 * i)
+    kinds = [e["kind"] for e in blackbox.events()]
+    assert "trend.alert" in kinds
+    assert "slo.alert" not in kinds  # early warning, zero damage yet
+    assert dict(tsdb._TREND_ACTIVE.samples())[("queue_depth",)] == 1.0
+    counter.add(good=0.0, bad=200.0)  # the queue finally tips over
+    clock.advance(30.0)
+    slo_eng.evaluate()
+    kinds = [e["kind"] for e in blackbox.events()]
+    assert "slo.alert" in kinds
+    assert kinds.index("trend.alert") < kinds.index("slo.alert")
