@@ -46,6 +46,14 @@ EXTRAS_SUBPROC_TIMEOUT = 360  # internal deadline 280 s + final section slack
 SERVING_SUBPROC_TIMEOUT = 420
 TRANSPORT_SUBPROC_TIMEOUT = 180  # 3 backends x (throughput + wakeup trials)
 LINEAGE_SUBPROC_TIMEOUT = 300  # tiny end-to-end lambda loop on CPU
+INDEX_SUBPROC_TIMEOUT = 600  # 2M-row IVF build (k-means + full assign) dominates
+
+# IVF index section shape: the largest CPU-feasible catalog that still
+# exercises the sublinear claim (>= 2M rows, acceptance floor). Row count is
+# CENTERS x reps so the planted-cluster recall reference is exact.
+INDEX_CENTERS = 2_048
+INDEX_N = INDEX_CENTERS * 1_024  # 2,097,152
+INDEX_BATCH = 16  # the coalescer's serving-shaped flush, where IVF lives
 
 # the launch environment's platform setting, BEFORE any fallback mutates it —
 # probes and accelerator subprocesses must see this, not a sticky "cpu"
@@ -64,19 +72,25 @@ def _subproc_env(force_cpu: bool) -> dict:
 
 
 def _probe_default_backend(timeout_sec: int) -> bool:
-    """True if the launch-default JAX backend initializes in a fresh process.
+    """True if the launch-default JAX backend initializes in a fresh process
+    AND is an accelerator.
 
     Guards against a hung accelerator tunnel: backend init has no internal
     timeout, so probe in a subprocess and fall back to CPU on failure rather
-    than hanging the benchmark forever."""
+    than hanging the benchmark forever. The probe also checks WHICH backend
+    initialized: a half-alive accelerator plugin can resolve to cpu after a
+    slow init, and leaving JAX_PLATFORMS unset in that state lets the
+    plugin's background retries contaminate the measured loops — pinning
+    cpu explicitly is both faster and honest about the backend column."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
             timeout=timeout_sec,
-            capture_output=True,
+            capture_output=True, text=True,
             env=_subproc_env(force_cpu=False),
         )
-        return proc.returncode == 0
+        return proc.returncode == 0 and proc.stdout.strip() != "cpu"
     except subprocess.TimeoutExpired:
         return False
 
@@ -250,6 +264,16 @@ def _serving_bench() -> dict:
         n_lsh += len(batch)
     lsh_qps = n_lsh / (time.perf_counter() - t2)
 
+    # sublinear-serving section in its OWN subprocess (2M-row IVF build +
+    # throughput duel needs clean device memory; a hang costs only its
+    # timeout) — same backend as this section: the child inherits the
+    # parent's resolved JAX_PLATFORMS via _subproc_env
+    index_section = _section_subproc(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench.py"),
+         "--index-bench"],
+        INDEX_SUBPROC_TIMEOUT, metric="ivf_index_serving",
+    )
+
     from oryx_tpu.common import metrics as metrics_mod
 
     return {
@@ -293,6 +317,122 @@ def _serving_bench() -> dict:
         "slowest_traces": slowest_traces,
         "http": http_section,
         "history": history_section,
+        "index": index_section,
+    }
+
+
+def _index_bench() -> dict:
+    """IVF-vs-quantized-flat serving throughput on ONE catalog (the round-19
+    sublinear-serving section; runs inside the --index-bench subprocess).
+
+    The catalog is a planted mixture (INDEX_CENTERS clusters) so recall@10
+    has an exact brute-force reference; both models share the same factor
+    arena and the same int8 quantization, isolating the candidate-generation
+    strategy. The 21M x 250f figure is PROJECTED from the per-query HBM
+    bytes model (docs/performance.md "Sublinear serving"), scaled by the
+    measured-vs-model efficiency at this shape and clamped at 1.0 — the
+    measured CPU speedup runs ABOVE the bytes model (the flat scan is
+    compute-bound on CPU), and the projection must not inherit that."""
+    from oryx_tpu.common.executils import pin_cpu_platform_if_forced
+
+    pin_cpu_platform_if_forced()
+    import jax
+
+    from oryx_tpu.models.als import ivf as ivf_mod
+    from oryx_tpu.models.als.serving import ALSServingModel
+
+    n, k, cells, probes = INDEX_N, FEATURES, INDEX_CENTERS, 8
+    rng = np.random.default_rng(42)
+    centers = rng.standard_normal((INDEX_CENTERS, k)).astype(np.float32) * 2.0
+    items = np.repeat(centers, n // INDEX_CENTERS, axis=0)
+    items += rng.standard_normal(items.shape).astype(np.float32) * 0.25
+    ids = [f"i{j}" for j in range(n)]
+
+    flat = ALSServingModel(k, implicit=True, device_dtype="int8")
+    flat.bulk_load_items(ids, items)
+    assert type(flat.y_snapshot()).__name__ == "_QuantSnapshot"
+
+    t0 = time.perf_counter()
+    m = ALSServingModel(k, implicit=True, device_dtype="int8",
+                        index_enabled=True, index_cells=cells,
+                        index_probes=probes)
+    m.y = flat.y  # share the arena: measure the index, not a second slab
+    m._snapshot = None
+    m._snapshot_src = None
+    snap = m.y_snapshot()
+    build_s = time.perf_counter() - t0
+    assert isinstance(snap, ivf_mod.IVFSnapshot)
+
+    # recall@10 against the exact f32 reference
+    qs = (centers[rng.integers(0, INDEX_CENTERS, 32)]
+          + rng.standard_normal((32, k)).astype(np.float32) * 0.25)
+    exact_scores = items @ qs.T
+    hits = 0
+    for b in range(len(qs)):
+        exact = set(np.argpartition(-exact_scores[:, b], 10)[:10])
+        got = {int(t[0][1:]) for t in m.top_n(qs[b], 10)}
+        hits += len(got & exact)
+    recall = hits / (10 * len(qs))
+
+    queries = (centers[rng.integers(0, INDEX_CENTERS, 4096)]
+               + rng.standard_normal((4096, k)).astype(np.float32) * 0.25)
+
+    def qps(model, batch, secs=3.0):
+        model.top_n_batch(queries[:batch], HOW_MANY)  # warm + compile
+        done = 0
+        t = time.perf_counter()
+        while time.perf_counter() - t < secs:
+            start = done % 4096
+            b = queries[start:start + batch]
+            if len(b) < batch:
+                b = queries[:batch]
+            model.top_n_batch(b, HOW_MANY)
+            done += batch
+        return done / (time.perf_counter() - t)
+
+    flat_qps = qps(flat, INDEX_BATCH)
+    ivf_qps = qps(m, INDEX_BATCH)
+    speedup = ivf_qps / flat_qps
+    flat_big = qps(flat, 256)
+    ivf_big = qps(m, 256)
+
+    def bytes_ratio(n_, k_, c_, width_, b_):
+        flat_bytes = n_ * k_ / b_
+        ivf_bytes = probes * width_ * k_ + c_ * k_ * 4.0 / b_
+        return flat_bytes / ivf_bytes
+
+    measured_ratio = bytes_ratio(n, k, cells, snap.cell_width, INDEX_BATCH)
+    # 21M x 250f: C = 4096 ~ sqrt(n), width = pow2(1.25 x n/C) = 8192
+    target_ratio = bytes_ratio(21_000_000, 250, 4_096, 8_192, INDEX_BATCH)
+    efficiency = min(1.0, speedup / measured_ratio)
+    projected = target_ratio * efficiency
+
+    return {
+        "metric": "ivf_index_serving",
+        "backend": jax.default_backend(),
+        "n_items": n,
+        "features": k,
+        "cells": snap.n_cells,
+        "probes": snap.probes,
+        "cell_width": snap.cell_width,
+        "skew": round(snap.skew(), 2),
+        "build_s": round(build_s, 1),
+        "batch": INDEX_BATCH,
+        "flat_qps": round(flat_qps, 1),
+        "ivf_qps": round(ivf_qps, 1),
+        "speedup": round(speedup, 2),
+        "batch_256": {
+            "flat_qps": round(flat_big, 1),
+            "ivf_qps": round(ivf_big, 1),
+            "speedup": round(ivf_big / flat_big, 2),
+        },
+        "recall_at_10": round(recall, 4),
+        "bytes_model": {
+            "measured_shape_ratio": round(measured_ratio, 2),
+            "ratio_21m_250f": round(target_ratio, 2),
+            "efficiency": round(efficiency, 2),
+        },
+        "projected_speedup_21m_250f": round(projected, 2),
     }
 
 
@@ -1105,7 +1245,8 @@ def main() -> None:
     # the serving section now contains the store-memory probes: its own
     # timeout must cover their per-child budgets, or the parent kill fires
     # first and erases the headline metric along with the memory section
-    serving_timeout = SERVING_SUBPROC_TIMEOUT + _store_section_budget(N_ITEMS)
+    serving_timeout = (SERVING_SUBPROC_TIMEOUT + _store_section_budget(N_ITEMS)
+                       + INDEX_SUBPROC_TIMEOUT)
     if "--big" in sys.argv:  # forward: adds the 6M-row memory section
         serving_argv.append("--big")
         serving_timeout += _store_section_budget(6_000_000)
@@ -1213,6 +1354,15 @@ if __name__ == "__main__":
         except Exception as e:  # noqa: BLE001 — always emit a JSON line
             print(json.dumps({
                 "metric": "time_to_model",
+                "error": f"{type(e).__name__}: {e}",
+            }))
+        sys.exit(0)
+    if "--index-bench" in sys.argv:
+        try:
+            print(json.dumps(_index_bench()))
+        except Exception as e:  # noqa: BLE001 — always emit a JSON line
+            print(json.dumps({
+                "metric": "ivf_index_serving",
                 "error": f"{type(e).__name__}: {e}",
             }))
         sys.exit(0)

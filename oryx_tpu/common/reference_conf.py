@@ -208,6 +208,28 @@ oryx = {
     # (pow2-rounded, floor 16). Higher = better recall under heavy
     # quantization error, more rescore work; 4 holds recall@10 >= 0.99.
     rescore-factor = 4
+    # Device-resident IVF candidate generation (models/als/ivf.py): cluster
+    # the item factors (in-tree k-means, deterministic seed), keep int8
+    # cells + f32 centroids in HBM, probe the top-P cells per query and
+    # scan ONLY those before the exact f32 arena rescore — per-query HBM
+    # traffic drops from n x k to probes x cell-width x k bytes
+    # (docs/performance.md "Sublinear serving"). Requires
+    # device-dtype = "int8" (degrades loudly otherwise).
+    index = {
+      enabled = false
+      # Cell count C (power of two). 0 sizes automatically to the pow2
+      # nearest sqrt(n) — the classic IVF probe/scan balance.
+      cells = 0
+      # Cells probed per query (power of two). Recall@10 >= 0.99 holds at
+      # 8 on clustered catalogs; single-query widening doubles this when
+      # host filtering consumes candidates.
+      probes = 8
+      # Re-cluster (full rebuild, fresh centroids) when the largest cell
+      # exceeds this multiple of the mean occupancy n/C: speed-tier
+      # fold-in drift concentrates rows and would otherwise stretch every
+      # probe's padded gather.
+      rebalance-skew = 4.0
+    }
     # Host factor-arena sizing (models/als/vectors.py): one contiguous
     # (rows, features) float32 slab per store, grown by doubling.
     arena = {

@@ -37,6 +37,7 @@ from oryx_tpu.common import lineage
 from oryx_tpu.common import metrics as metrics_mod
 from oryx_tpu.common import profiling
 from oryx_tpu.common import spans
+from oryx_tpu.models.als import ivf as ivf_mod
 from oryx_tpu.models.als import pmml_codec
 from oryx_tpu.models.als.lsh import LocalitySensitiveHash
 from oryx_tpu.models.als.rescorer import load_rescorer_providers
@@ -599,6 +600,10 @@ class ALSServingModel(ServingModel):
         shard_axis: str = "model",
         device_dtype: str = "auto",
         rescore_factor: float = 4.0,
+        index_enabled: bool = False,
+        index_cells: int = 0,
+        index_probes: int = 8,
+        index_skew: float = 4.0,
     ):
         self.features = features
         self.implicit = implicit
@@ -616,6 +621,19 @@ class ALSServingModel(ServingModel):
                 "using bfloat16 for the sharded scoring copy"
             )
             device_dtype = "bfloat16"
+        if index_enabled and device_dtype != "int8":
+            # the IVF cells ARE the int8 representation (and the rescore
+            # rides the int8 mode's pinned arena-slab view) — any other
+            # resolved dtype means the index cannot engage
+            log.warning(
+                "oryx.serving.index.enabled requires device-dtype=int8 "
+                "(resolved %r); serving without the IVF index", device_dtype
+            )
+            index_enabled = False
+        self.index_enabled = bool(index_enabled)
+        self.index_cells = int(index_cells)
+        self.index_probes = max(1, int(index_probes))
+        self.index_skew = max(1.0, float(index_skew))
         self.device_dtype = device_dtype
         self.rescore_factor = max(1.0, float(rescore_factor))
         self.mesh = mesh
@@ -720,6 +738,8 @@ class ALSServingModel(ServingModel):
     # -- device snapshot ----------------------------------------------------
     def y_snapshot(self):
         if self.device_dtype == "int8":
+            if self.index_enabled:
+                return self._ivf_snapshot()
             return self._quant_snapshot()
         ids, mat = self.y.materialize()
         with self._snap_lock:
@@ -760,6 +780,35 @@ class ALSServingModel(ServingModel):
             ids, host, version, row_view = self.y.host_matrix()
             self._snapshot = _QuantSnapshot.build(
                 ids, host, version, self.lsh, row_view, prev=prev
+            )
+            return self._snapshot
+
+    def _ivf_snapshot(self) -> "ivf_mod.IVFSnapshot":
+        """Current IVF device view: incremental (requantize + reassign only
+        the rows a speed microbatch touched, rewrite only the affected
+        cells) when the arena's write log covers the gap AND the update
+        neither overflows a cell nor drifts the balance past the skew
+        bound; full re-cluster rebuild otherwise."""
+        with self._snap_lock:
+            prev = (self._snapshot
+                    if isinstance(self._snapshot, ivf_mod.IVFSnapshot)
+                    else None)
+            if prev is not None and prev.cell_q is not None:
+                delta = self.y.delta_info(prev.version, len(prev.ids))
+                if delta is not None:
+                    if not delta.changed_ids and not delta.appended_ids:
+                        return prev
+                    nxt = ivf_mod.IVFSnapshot.from_delta(
+                        prev, delta, self.lsh
+                    )
+                    if nxt is not None:
+                        self._snapshot = nxt
+                        return nxt
+            ids, host, version, row_view = self.y.host_matrix()
+            self._snapshot = ivf_mod.IVFSnapshot.build(
+                ids, host, version, self.lsh, row_view, prev=prev,
+                cells=self.index_cells, probes=self.index_probes,
+                skew_bound=self.index_skew,
             )
             return self._snapshot
 
@@ -888,9 +937,15 @@ class ALSServingModel(ServingModel):
         are masked on device; ``allowed``/``rescore`` host hooks (rescorer SPI)
         filter the candidate stream with widening retry."""
         snap = self.y_snapshot()
-        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
+        if snap.n == 0 or (snap.mat is None and not isinstance(
+                snap, (_QuantSnapshot, ivf_mod.IVFSnapshot))):
             return []
         q_host = np.asarray(query_vec, dtype=np.float32)
+        if isinstance(snap, ivf_mod.IVFSnapshot):
+            return ivf_mod.top_n(
+                self, snap, q_host, how_many, offset, allowed, rescore,
+                excluded,
+            )
         if isinstance(snap, _QuantSnapshot):
             return self._quant_top_n(
                 snap, q_host, how_many, offset, allowed, rescore, excluded
@@ -995,10 +1050,15 @@ class ALSServingModel(ServingModel):
         excluded: "Sequence[Sequence[str] | None] | None" = None,
     ) -> list[list[tuple[str, float]]]:
         snap = self.y_snapshot()
-        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
+        if snap.n == 0 or (snap.mat is None and not isinstance(
+                snap, (_QuantSnapshot, ivf_mod.IVFSnapshot))):
             return [[] for _ in range(len(query_vecs))]
         qs_host = np.asarray(query_vecs, dtype=np.float32)
         filtering = alloweds is not None and any(a is not None for a in alloweds)
+        if isinstance(snap, ivf_mod.IVFSnapshot):
+            return ivf_mod.top_n_batch(
+                self, snap, qs_host, how_many, alloweds, excluded, filtering
+            )
         if isinstance(snap, _QuantSnapshot):
             return self._quant_top_n_batch(
                 snap, qs_host, how_many, alloweds, excluded, filtering
@@ -1156,7 +1216,8 @@ class ALSServingModel(ServingModel):
         import jax
 
         snap = self.y_snapshot()
-        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
+        if snap.n == 0 or (snap.mat is None and not isinstance(
+                snap, (_QuantSnapshot, ivf_mod.IVFSnapshot))):
             raise ValueError("no item factors to warm against yet")
         qs_struct = jax.ShapeDtypeStruct(
             (batch_size, self.features), jnp.float32
@@ -1164,7 +1225,12 @@ class ALSServingModel(ServingModel):
         excl_struct = jax.ShapeDtypeStruct(
             (batch_size, _EXCL_PAD_MIN), jnp.int32
         )
-        if isinstance(snap, _QuantSnapshot):
+        if isinstance(snap, ivf_mod.IVFSnapshot):
+            # the IVF ladder: pow2 (batch, probes) probe + scan signatures
+            # under their own cost keys; the shared zero-batch executions
+            # below then populate the exact dispatch caches requests hit
+            ivf_mod.warm_bucket(self, snap, batch_size, how_many)
+        elif isinstance(snap, _QuantSnapshot):
             # the quantized ladder: its programs (and so its AOT cost keys)
             # are distinct from the f32/bf16 scan's — a quantized-model
             # handoff warms exactly the signatures its traffic dispatches
@@ -1226,7 +1292,8 @@ class ALSServingModel(ServingModel):
                 lut_struct, snap.buckets, excl_struct, k,
                 cost_key=_topn_cost_key(batch_size, True),
             )
-        if snap.sharded_mat is None and not isinstance(snap, _QuantSnapshot):
+        if snap.sharded_mat is None and not isinstance(
+                snap, (_QuantSnapshot, ivf_mod.IVFSnapshot)):
             # mark both signatures attempted: the lazy first-use
             # registration in _top_n_batch would otherwise re-lower and
             # re-compile each one the ladder just registered — once per
@@ -1255,9 +1322,16 @@ class ALSServingModel(ServingModel):
     ) -> list[tuple[str, float]]:
         """Mean-cosine top-N for /similarity (CosineAverageFunction.java:67)."""
         snap = self.y_snapshot()
-        if snap.n == 0 or (snap.mat is None and not isinstance(snap, _QuantSnapshot)):
+        if snap.n == 0 or (snap.mat is None and not isinstance(
+                snap, (_QuantSnapshot, ivf_mod.IVFSnapshot))):
             return []
         qs_host = np.atleast_2d(np.asarray(query_vecs, dtype=np.float32))
+        if isinstance(snap, ivf_mod.IVFSnapshot):
+            return ivf_mod.top_n_cosine(
+                self, snap, qs_host,
+                np.linalg.norm(qs_host, axis=1), how_many, offset,
+                allowed, rescore,
+            )
         qs = jnp.asarray(qs_host)
         q_norms = jnp.linalg.norm(qs, axis=1)
         # union of candidate buckets across ALL query vectors, mirroring the
@@ -1323,6 +1397,8 @@ class ALSServingModel(ServingModel):
         scoring copy + norms + buckets, or the int8 slab + scales) — the
         HBM side of the bench memory section's f32-vs-int8 comparison."""
         snap = self.y_snapshot()
+        if isinstance(snap, ivf_mod.IVFSnapshot):
+            return snap.device_nbytes()
         arrays = (
             (snap.qmat, snap.qscale, snap.norms, snap.buckets)
             if isinstance(snap, _QuantSnapshot)
@@ -1385,6 +1461,17 @@ class ALSServingModelManager(AbstractServingModelManager):
             )
         self.rescore_factor = config.get_float(
             "oryx.serving.rescore-factor", 4.0
+        )
+        # device-resident IVF candidate generation (sublinear serving
+        # scan); engages only with device-dtype=int8 — the cells are the
+        # int8 representation and the rescore rides the arena slab
+        self.index_enabled = config.get_bool(
+            "oryx.serving.index.enabled", False
+        )
+        self.index_cells = config.get_int("oryx.serving.index.cells", 0)
+        self.index_probes = config.get_int("oryx.serving.index.probes", 8)
+        self.index_skew = config.get_float(
+            "oryx.serving.index.rebalance-skew", 4.0
         )
         # opportunistic YᵀY pre-trigger once the model is loaded enough, so
         # the first fold-in request doesn't stall on the factorization
@@ -1496,6 +1583,10 @@ class ALSServingModelManager(AbstractServingModelManager):
                     features, meta["implicit"], self.sample_rate,
                     mesh=self.mesh, device_dtype=self.device_dtype,
                     rescore_factor=self.rescore_factor,
+                    index_enabled=self.index_enabled,
+                    index_cells=self.index_cells,
+                    index_probes=self.index_probes,
+                    index_skew=self.index_skew,
                 )
                 # the handoff meta names every expected row: presize the
                 # arenas so the fill skips doubling-growth copies

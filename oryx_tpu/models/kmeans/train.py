@@ -19,7 +19,11 @@ programs shaped for the MXU:
     k-means++ (sequential D² sampling — the same seeding MLlib's k-means‖
     approximates, exact here because a TPU sweep over N points is one matmul).
 
-Empty clusters keep their previous center (MLlib behavior).
+Empty clusters keep their previous center (MLlib behavior) in the
+lambda-tier trainer; :func:`fit_index_centroids` (the serving IVF index's
+entry point) instead RESEEDS empty clusters to the points currently worst
+served, because a dead cell in an inverted-file index is pure wasted probe
+width.
 """
 
 from __future__ import annotations
@@ -137,6 +141,90 @@ def _kmeans_pallas_run(key, points, weights, k, iterations, init, interpret):
             new_centers = sums[:k, :d] / jnp.maximum(counts, 1.0)[:, None]
             centers = jnp.where((counts > 0)[:, None], new_centers, centers)
     return centers, counts, cost
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iterations"))
+def _lloyd_from(points, centers, k: int, iterations: int):
+    """``iterations`` Lloyd sweeps from GIVEN centers; returns the final
+    (centers, counts, assign). Factored out of ``_kmeans_single_run`` so the
+    empty-cluster reseeding loop can resume sweeps from patched centers."""
+    weights = jnp.ones((points.shape[0],), dtype=points.dtype)
+
+    def lloyd(centers, _):
+        d2 = _sq_dists(points, centers)
+        a = jax.nn.one_hot(d2.argmin(axis=1), k, dtype=points.dtype)
+        counts = a.sum(axis=0)
+        sums = a.T @ points
+        new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+        centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+        return centers, None
+
+    centers, _ = jax.lax.scan(lloyd, centers, None, length=iterations)
+    d2 = _sq_dists(points, centers)
+    assign = d2.argmin(axis=1)
+    counts = (jax.nn.one_hot(assign, k, dtype=points.dtype) * weights[:, None]).sum(0)
+    return centers, counts, assign
+
+
+def _reseed_empty(points: np.ndarray, centers: np.ndarray,
+                  counts: np.ndarray, assign: np.ndarray) -> np.ndarray:
+    """Move each empty cluster's center onto the point FARTHEST from its
+    assigned center (distinct points, worst-served first) — the standard
+    empty-cluster repair. Returns patched centers; no-op when none empty."""
+    empty = np.flatnonzero(counts == 0)
+    if empty.size == 0:
+        return centers
+    d2 = ((points - centers[assign]) ** 2).sum(axis=1)
+    order = np.argsort(-d2, kind="stable")
+    centers = centers.copy()
+    for j, c in enumerate(empty[: len(order)]):
+        centers[c] = points[order[j]]
+    return centers
+
+
+def fit_index_centroids(
+    points: np.ndarray,
+    k: int,
+    iterations: int = 20,
+    seed: int = 0,
+    reseed_rounds: int = 4,
+) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+    """Deterministic bounded k-means fit for the serving IVF index
+    (models/als/ivf.py): k-means++ init from a FIXED seed, at most
+    ``iterations`` Lloyd sweeps, then up to ``reseed_rounds`` empty-cluster
+    repairs (reseed to worst-served points + 2 more sweeps each) so a
+    planted-structure fit cannot emit dead cells while distinct points
+    remain. Returns (centers (k,d) f32, counts (k,) i64, assign (n,) i32) —
+    the assignment rides along so the index build skips a second pass.
+
+    Unlike :func:`kmeans_train` this takes no PRNG plumbing and runs no
+    restarts: the index rebuild path needs reproducibility (the incremental
+    -maintenance-equals-rebuild invariant is tested bit-exactly) more than
+    it needs the last percent of quantization error."""
+    points = np.ascontiguousarray(np.asarray(points, dtype=np.float32))
+    n = len(points)
+    if n == 0:
+        raise ValueError("no points")
+    k = max(1, min(int(k), n))
+    pts = jnp.asarray(points)
+    key = jax.random.PRNGKey(int(seed))
+    centers = _init_centers(key, pts, k, INIT_KMEANS_PARALLEL)
+    centers, counts, assign = _lloyd_from(pts, centers, k, int(iterations))
+    centers_np, counts_np, assign_np = jax.device_get((centers, counts, assign))
+    for _ in range(max(0, int(reseed_rounds))):
+        if (counts_np > 0).all():
+            break
+        patched = _reseed_empty(points, np.asarray(centers_np, dtype=np.float32),
+                                counts_np, assign_np)
+        centers, counts, assign = _lloyd_from(pts, jnp.asarray(patched), k, 2)
+        centers_np, counts_np, assign_np = jax.device_get(
+            (centers, counts, assign)
+        )
+    return (
+        np.asarray(centers_np, dtype=np.float32),
+        np.asarray(counts_np, dtype=np.int64),
+        np.asarray(assign_np, dtype=np.int32),
+    )
 
 
 def kmeans_train(

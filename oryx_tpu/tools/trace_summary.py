@@ -622,12 +622,20 @@ def _num(v) -> "float | None":
     return float(v) if isinstance(v, (int, float)) else None
 
 
-def _hist_p99(rec: dict) -> "float | None":
+def _hist_p99(rec: dict) -> "tuple[float | None, str | None]":
+    """(p99, source): endpoint-level http p99 when the round measured it,
+    else the single-query latency p99. The source rides along because the
+    two measure DIFFERENT things (a 96-way-concurrent endpoint burst with
+    queueing vs one uncontended device call) — the regression gate must
+    only compare rounds whose p99 came from the same source, or the round
+    that first grows an http section trips the gate on a methodology
+    change instead of a regression."""
     http = rec.get("http") or {}
     if isinstance(http.get("p99_ms"), (int, float)):
-        return float(http["p99_ms"])
+        return float(http["p99_ms"]), "http"
     lat = rec.get("latency_ms") or {}
-    return _num(lat.get("p99"))
+    p99 = _num(lat.get("p99"))
+    return p99, ("single" if p99 is not None else None)
 
 
 def load_history_records(paths: list) -> list:
@@ -693,12 +701,14 @@ def _history_row(label: str, rec: dict) -> dict:
         if isinstance(o, dict)
     ]
     budgets = [b for b in budgets if isinstance(b, (int, float))]
+    p99, p99_src = _hist_p99(rec)
     return {
         "round": label,
         "backend": rec.get("backend", "?"),
         "qps": _num(rec.get("value")),
         "http_qps": _num((rec.get("http") or {}).get("value")),
-        "p99_ms": _hist_p99(rec),
+        "p99_ms": p99,
+        "p99_src": p99_src,
         "mfu": _num(batch.get("mfu")),
         "pack_s": _num(batch.get("pack_s")),
         "elapsed_s": _num(batch.get("elapsed_s")),
@@ -722,6 +732,10 @@ def _history_row(label: str, rec: dict) -> dict:
         # as a sparkline. Same backward tolerance as ttm_s: pre-18 BENCH
         # rounds have no key and render "-".
         "qps_trend": _qps_trend(rec),
+        # round-19 index section (bench.py --index-bench): IVF-vs-flat
+        # serving speedup at the sublinear shape. Same backward tolerance:
+        # pre-19 rounds have no cell and never compare.
+        "ivf_speedup": _num((rec.get("index") or {}).get("speedup")),
     }
 
 
@@ -752,7 +766,7 @@ def render_history(records: list, regress_pct: float = 25.0,
       f"{'p99_ms':>9s} {'mfu':>8s} {'pack_s':>8s} {'elapsed_s':>9s} "
       f"{'peak_rss':>9s} {'arena':>6s} {'int8':>5s} {'ckpt_ov':>7s} "
       f"{'resume_sv':>9s} {'burn':>6s} {'budget':>6s} {'alrt':>4s} "
-      f"{'ttm_s':>7s} {'qps~':>8s}\n")
+      f"{'ttm_s':>7s} {'qps~':>8s} {'ivf':>6s}\n")
     for r in rows:
         # pack-vs-device-wall verdict rides next to elapsed: "<" = the
         # host pack fits under the device loop (ROADMAP item 2's target)
@@ -774,7 +788,8 @@ def render_history(records: list, regress_pct: float = 25.0,
           f"{cell(r['slo_budget'], '{:6.3f}', 6)} "
           f"{cell(r['slo_alerts'], '{:4d}', 4)} "
           f"{cell(r['ttm_s'], '{:6.1f}s', 7)} "
-          f"{(r['qps_trend'] or '-'):>8s}\n")
+          f"{(r['qps_trend'] or '-'):>8s} "
+          f"{cell(r['ivf_speedup'], '{:5.1f}x', 6)}\n")
     if regress_pct <= 0 or len(rows) < 2:
         return 0
     last = rows[-1]
@@ -785,12 +800,18 @@ def render_history(records: list, regress_pct: float = 25.0,
             continue
         # compare only against a round measured on the SAME backend: a CPU
         # fallback round "regressing" against an on-chip round is a tunnel
-        # story, not a code regression (unknown backends match anything)
+        # story, not a code regression (unknown backends match anything).
+        # p99 additionally requires the same SOURCE (http vs single-query
+        # — see _hist_p99): the first round to grow an http section must
+        # start a new comparison chain, not compare against a different
+        # measurement.
         prev_row = next(
             (r for r in reversed(rows[:-1])
              if r[column] is not None
              and ("?" in (r["backend"], last["backend"])
-                  or r["backend"] == last["backend"])), None
+                  or r["backend"] == last["backend"])
+             and (column != "p99_ms"
+                  or r["p99_src"] == last["p99_src"])), None
         )
         if prev_row is None or prev_row[column] == 0:
             continue
